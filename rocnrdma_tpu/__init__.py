@@ -1,0 +1,30 @@
+"""rocnrdma_tpu — a TPU-native zero-copy RDMA framework.
+
+Re-imagines the capabilities of AMD's ``amdp2p`` PeerDirect bridge
+(reference: rocmarchive/ROCnRDMA, ``amdp2p.c``) for TPU hardware:
+
+- ``hbm``: pin-lifecycle layer over accelerator memory, mirroring the
+  semantics of the reference's ``peer_memory_client`` callbacks
+  (``amdp2p.c:363-371``) and their revocation handshake
+  (``amdp2p.c:88-109``), re-based on dma-buf export instead of the AMD
+  KFD RDMA interface.
+- ``transport``: Python bindings to the native C++ engine (``native/``)
+  providing MR registration, RC-style queue pairs, one-sided RDMA
+  WRITE/READ and two-sided SEND/RECV with completions. Backends: real
+  InfiniBand verbs (dlopen'd libibverbs, incl. ``ibv_reg_dmabuf_mr``)
+  and a hardware-free emulated backend for CI.
+- ``collectives``: cross-slice (DCN) ring allreduce over the transport,
+  replacing XLA's host-staged DCN copy, plus staging-byte accounting.
+- ``parallel`` / ``models`` / ``ops``: the JAX consumer stack — device
+  meshes, a Llama model family, Pallas TPU kernels, and a DP trainer
+  whose cross-slice gradient allreduce rides the zero-copy path.
+
+The reference is a transport layer with zero software on the per-message
+hot path (all work front-loaded into registration, ``amdp2p.c:219-264``);
+that invariant is preserved here: after ``register``, data movement is
+NIC hardware (or, in the emulated backend, the progress engine) only.
+"""
+
+__version__ = "0.1.0"
+
+from rocnrdma_tpu.utils.trace import trace  # noqa: F401

@@ -1,0 +1,102 @@
+"""JAX collective shim — cross-slice (DCN) allreduce over the RDMA path.
+
+This is the layer with no counterpart inside the reference (its L5
+consumers were external MPI apps, README.md:64); BASELINE.md configs
+3-4 make it part of this framework: route the cross-slice portion of a
+multi-slice allreduce over the zero-copy transport instead of XLA's
+host-staged DCN copy, leaving intra-slice traffic on ICI where XLA's
+own collectives are already optimal (SURVEY.md §5 "Distributed
+communication backend").
+
+Data path per pytree:
+  1. Leaves are grouped by dtype and packed into one flat buffer per
+     dtype (bigger messages ⇒ ring stays at peak bus bandwidth).
+  2. Zero-copy attempt: export each device buffer as dma-buf and
+     register it with the engine directly (no host bytes; the MR posts
+     read TPU HBM). Gated on the exporter — current public libtpu
+     cannot export, so:
+  3. Staged fallback: device→host get, ring allreduce on the host
+     buffer, host→device put — with every staged byte charged to
+     ``collectives.staging`` so the distance from the zero-staging
+     target is always visible.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from rocnrdma_tpu.collectives.staging import staging
+from rocnrdma_tpu.collectives.world import RingWorld
+from rocnrdma_tpu.hbm.registry import HbmError, MemoryExporter
+from rocnrdma_tpu.transport.engine import RED_SUM
+from rocnrdma_tpu.utils.trace import trace
+
+
+def _leaf_list(tree) -> List[Any]:
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+class CrossSliceAllReduce:
+    """Callable allreduce over pytrees of jax.Arrays (or numpy arrays).
+
+    ``mean=True`` divides by world size after the sum — the gradient
+    averaging used by the DP trainer (BASELINE.md config 4).
+    """
+
+    def __init__(self, world: RingWorld,
+                 exporter: Optional[MemoryExporter] = None,
+                 mean: bool = False):
+        self.world = world
+        self.exporter = exporter
+        self.mean = mean
+
+    def _allreduce_host(self, flat: np.ndarray) -> None:
+        staging.add(flat.nbytes * 2)  # D2H + H2D round trip
+        self.world.allreduce(flat, RED_SUM)
+
+    def __call__(self, tree):
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not leaves:
+            return tree
+
+        # Group leaf indices by dtype; one packed ring op per dtype.
+        groups: Dict[str, List[int]] = {}
+        for i, leaf in enumerate(leaves):
+            groups.setdefault(str(leaf.dtype), []).append(i)
+
+        out: List[Any] = list(leaves)
+        for dtype_str, idxs in groups.items():
+            host_parts = []
+            for i in idxs:
+                # Zero-copy path would go here (export_dmabuf +
+                # reg_dmabuf_mr); with no exporter it is the staged get.
+                host_parts.append(np.asarray(jax.device_get(leaves[i])))
+            shapes = [p.shape for p in host_parts]
+            sizes = [p.size for p in host_parts]
+            flat = np.concatenate([p.reshape(-1) for p in host_parts]) \
+                if len(host_parts) > 1 else host_parts[0].reshape(-1).copy()
+            flat = np.ascontiguousarray(flat)
+            self._allreduce_host(flat)
+            if self.mean:
+                if flat.dtype == np.dtype("int32") or \
+                        flat.dtype == np.dtype("int64"):
+                    flat = flat // self.world.world
+                else:
+                    flat = (flat.astype(np.float32) / self.world.world) \
+                        .astype(flat.dtype)
+            offset = 0
+            for i, shape, size in zip(idxs, shapes, sizes):
+                piece = flat[offset:offset + size].reshape(shape)
+                offset += size
+                out[i] = jax.device_put(jnp.asarray(piece)) \
+                    if not isinstance(leaves[i], np.ndarray) else piece
+        trace.event("xslice.allreduce",
+                    leaves=len(leaves), groups=len(groups))
+        return jax.tree_util.tree_unflatten(treedef, out)

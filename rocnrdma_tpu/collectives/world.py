@@ -1,0 +1,105 @@
+"""Ring-world bootstrap: N ranks connected in a ring over the engine.
+
+The reference delegated rendezvous entirely to its consumers (perftest
+and MPI bring their own TCP bootstrap); here it is part of the
+framework. Each rank accepts a connection from its left neighbor on
+``base_port + rank`` and dials its right neighbor at
+``base_port + (rank+1) % world`` — a deadlock-free scheme because
+connects retry until the listener is up (tcp_connect_retry).
+
+Works identically for in-process multi-rank tests (one Engine per rank,
+threads), multi-process single-host, and multi-host (pass ``peers``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+from rocnrdma_tpu.transport.engine import Engine, QueuePair, Ring, RED_SUM
+from rocnrdma_tpu.utils.trace import trace
+
+
+class RingWorld:
+    def __init__(
+        self,
+        engine: Engine,
+        rank: int,
+        world: int,
+        base_port: int,
+        peers: Optional[Sequence[str]] = None,
+        bind_host: str = "0.0.0.0",
+        timeout_ms: int = 30000,
+    ):
+        if world < 2:
+            raise ValueError("RingWorld needs world >= 2")
+        self.engine = engine
+        self.rank = rank
+        self.world = world
+        peers = list(peers) if peers else ["127.0.0.1"] * world
+        right = (rank + 1) % world
+
+        accepted: List[Optional[QueuePair]] = [None]
+        err: List[Optional[BaseException]] = [None]
+
+        def _accept():
+            try:
+                accepted[0] = engine.listen(
+                    "127.0.0.1" if peers[rank] in ("127.0.0.1", "localhost")
+                    else bind_host,
+                    base_port + rank)
+            except BaseException as e:  # surfaced after join
+                err[0] = e
+
+        t = threading.Thread(target=_accept, daemon=True)
+        t.start()
+        self.right_qp = engine.connect(peers[right], base_port + right,
+                                       timeout_ms)
+        t.join(timeout_ms / 1000)
+        if err[0] is not None:
+            raise err[0]
+        if accepted[0] is None:
+            raise TimeoutError("left neighbor never connected")
+        self.left_qp = accepted[0]
+        self.ring = Ring(engine, self.left_qp, self.right_qp, rank, world)
+        trace.event("world.up", rank=rank, world=world)
+
+    def allreduce(self, array, op: int = RED_SUM) -> None:
+        """In-place ring allreduce of a C-contiguous numpy array."""
+        self.ring.allreduce(array, op)
+
+    def close(self) -> None:
+        self.ring.destroy()
+        self.left_qp.close()
+        self.right_qp.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def local_worlds(n: int, base_port: int, spec: str = "emu"
+                 ) -> List[RingWorld]:
+    """Bring up an n-rank ring fully in-process (one Engine per rank,
+    one thread per rank during bootstrap) — the test/bench topology."""
+    engines = [Engine(spec) for _ in range(n)]
+    out: List[Optional[RingWorld]] = [None] * n
+    errs: List[Optional[BaseException]] = [None] * n
+
+    def boot(r: int):
+        try:
+            out[r] = RingWorld(engines[r], r, n, base_port)
+        except BaseException as e:
+            errs[r] = e
+
+    threads = [threading.Thread(target=boot, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for e in errs:
+        if e is not None:
+            raise e
+    return [w for w in out if w is not None]

@@ -1,0 +1,166 @@
+"""TPU exporter — the MemoryExporter contract over real JAX arrays.
+
+This is the layer whose role the AMD KFD RDMA interface played for the
+reference (SURVEY.md §2 component 7), rebuilt for XLA's buffer model:
+
+- Device addresses come from the array's backing buffer
+  (``unsafe_buffer_pointer``), the TPU analogue of the GPU VA that
+  ``is_gpu_address`` classified (amdp2p.c:127).
+- Pinning is reference-holding: XLA frees a buffer when its last
+  reference dies, so a pin holds the array object, which is the
+  idiomatic resolution of SURVEY.md §7 hard-part 3 ("JAX buffers
+  move/donate/defragment; a registered MR must pin placement or track
+  invalidation"). Donation of a pinned array is the caller's bug, and
+  ``revoke()`` exists to model exactly that teardown.
+- dma-buf export: probed against libtpu; current public libtpu builds
+  do not expose HBM dma-buf export, so ``export_dmabuf`` raises and
+  callers fall back to the host-staged path — with every staged byte
+  accounted (collectives.staging) so the "zero host staging" target of
+  BASELINE.md config 3 is measurable the day the export lands.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from rocnrdma_tpu.hbm.registry import (
+    DEFAULT_PAGE_SIZE,
+    HbmError,
+    MemoryExporter,
+    PinnedPages,
+)
+from rocnrdma_tpu.utils.trace import trace
+
+# TPU HBM pages are 4 KiB-granular from the host's mapping viewpoint;
+# match the reference's fallback (amdp2p.c:339) until libtpu exposes a
+# query.
+TPU_PAGE_SIZE = DEFAULT_PAGE_SIZE
+
+
+_synthetic_lock = threading.Lock()
+_synthetic_next = [1 << 44]  # far from any real mapping
+
+
+def _synthetic_va(nbytes: int) -> int:
+    """Some PJRT plugins (e.g. the axon TPU tunnel) don't expose raw
+    buffer pointers. Without dma-buf export a real pointer buys nothing
+    — the VA is only the registry key — so hand out a unique synthetic
+    range instead of failing the whole lifecycle."""
+    with _synthetic_lock:
+        va = _synthetic_next[0]
+        _synthetic_next[0] += (nbytes + TPU_PAGE_SIZE - 1) // TPU_PAGE_SIZE * \
+            TPU_PAGE_SIZE + TPU_PAGE_SIZE
+        return va
+
+
+def buffer_pointer(arr) -> int:
+    """Device pointer of a jax.Array's (single) backing buffer, or a
+    synthetic stand-in when the PJRT plugin hides raw pointers."""
+    try:
+        if hasattr(arr, "unsafe_buffer_pointer"):
+            return arr.unsafe_buffer_pointer()
+        shards = getattr(arr, "addressable_shards", None)
+        if shards and len(shards) == 1:
+            return shards[0].data.unsafe_buffer_pointer()
+    except Exception:
+        pass
+    return _synthetic_va(arr.nbytes)
+
+
+class TPUExporter(MemoryExporter):
+    """Pin-lifecycle provider for JAX arrays.
+
+    Arrays are adopted into the exporter (``adopt``), which makes their
+    device range classifiable and pinnable; ``release`` drops the
+    adoption and fires revocation on any live pins — the process-exit /
+    free path of the reference (SURVEY.md §3.4) under test control.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # va -> (array ref, nbytes)
+        self._adopted: Dict[int, Tuple[object, int]] = {}
+        # id(pinned) -> (pinned, free_cb, priv)
+        self._pins: Dict[int, Tuple[PinnedPages, Optional[Callable], object]] = {}
+
+    def adopt(self, arr) -> int:
+        va = buffer_pointer(arr)
+        nbytes = arr.nbytes
+        with self._lock:
+            self._adopted[va] = (arr, nbytes)
+        trace.event("tpu.adopt", va=va, bytes=nbytes)
+        return va
+
+    def release(self, va: int) -> None:
+        with self._lock:
+            if va not in self._adopted:
+                raise HbmError(f"release of unadopted va {va:#x}")
+            nbytes = self._adopted[va][1]
+            doomed = [
+                (p, cb, priv)
+                for (p, cb, priv) in self._pins.values()
+                if va <= p.va < va + nbytes and not p._released
+            ]
+        for pinned, cb, priv in doomed:
+            if cb is not None:
+                cb(priv)
+            with self._lock:
+                pinned._released = True
+                self._pins.pop(id(pinned), None)
+        with self._lock:
+            del self._adopted[va]
+        trace.event("tpu.release", va=va, revoked=len(doomed))
+
+    def _containing(self, va: int) -> Optional[Tuple[int, int]]:
+        for base, (_, nbytes) in self._adopted.items():
+            if base <= va < base + nbytes:
+                return base, nbytes
+        return None
+
+    def is_device_address(self, va: int, size: int = 1) -> bool:
+        with self._lock:
+            hit = self._containing(va)
+            return hit is not None and va + size <= hit[0] + hit[1]
+
+    def get_pages(self, va, size, free_callback=None, client_priv=None):
+        with self._lock:
+            hit = self._containing(va)
+            if hit is None or va + size > hit[0] + hit[1]:
+                raise HbmError(f"get_pages: [{va:#x},+{size}) not adopted")
+            pages = []
+            off = va
+            end = va + size
+            while off < end:
+                page_end = (off // TPU_PAGE_SIZE + 1) * TPU_PAGE_SIZE
+                chunk = min(end, page_end) - off
+                pages.append((off, chunk))
+                off += chunk
+            pinned = PinnedPages(va=va, size=size, pages=pages, exporter=self)
+            self._pins[id(pinned)] = (pinned, free_callback, client_priv)
+        trace.event("tpu.get_pages", va=va, bytes=size)
+        return pinned
+
+    def put_pages(self, pinned: PinnedPages) -> None:
+        with self._lock:
+            if pinned._released:
+                return
+            pinned._released = True
+            self._pins.pop(id(pinned), None)
+        trace.event("tpu.put_pages", va=pinned.va)
+
+    def get_page_size(self, va: int) -> int:
+        return TPU_PAGE_SIZE
+
+    def export_dmabuf(self, pinned: PinnedPages) -> Tuple[int, int]:
+        # Probe order, mirroring SURVEY.md §7 risk #1: a libtpu dma-buf
+        # export API, else the kernel shim (kernelmod/tpup2p). Neither
+        # exists in current public stacks, so the legacy host-staged
+        # path (with staging accounting) is taken by callers.
+        raise HbmError(
+            "TPU HBM dma-buf export unavailable in this libtpu build; "
+            "use the staged path or the tpup2p kernel shim")
+
+    def live_pins(self) -> int:
+        with self._lock:
+            return len(self._pins)

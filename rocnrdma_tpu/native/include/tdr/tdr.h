@@ -1,0 +1,157 @@
+/* tdr.h — C API of the TPU-Direct-RDMA native engine (libtdr).
+ *
+ * Role in the stack: the userspace half of what the reference split
+ * between OFED ib_core and the amdp2p bridge (amdp2p.c). Where the
+ * reference's public surface is a callback table polled by the kernel
+ * (the 7-entry peer_memory_client ops, amdp2p.c:363-371), this engine
+ * exposes the registration + RC queue-pair surface directly to the
+ * framework: register memory (host pointer or dma-buf fd), bring up a
+ * reliable connection, post one-sided WRITE/READ and two-sided
+ * SEND/RECV, poll completions.
+ *
+ * Invariant preserved from the reference (SURVEY.md §3.3): all mapping
+ * work is front-loaded into registration; posting a transfer performs
+ * no per-byte software work beyond handing the NIC (or the emulated
+ * progress engine) a descriptor.
+ *
+ * Two backends, selected at runtime:
+ *   - "verbs": real InfiniBand via dlopen(libibverbs.so.1), including
+ *     ibv_reg_dmabuf_mr for accelerator HBM (SURVEY.md §7 design
+ *     stance: dma-buf is the idiomatic modern path).
+ *   - "emu":   hardware-free emulation over TCP with a progress thread
+ *     standing in for the HCA — the "fake L2 backend" SURVEY.md §4
+ *     calls out as the reference's biggest testing gap.
+ */
+#ifndef TDR_H_
+#define TDR_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tdr_engine tdr_engine;
+typedef struct tdr_mr tdr_mr;
+typedef struct tdr_qp tdr_qp;
+
+enum {
+  TDR_ENGINE_EMU = 0,
+  TDR_ENGINE_VERBS = 1,
+};
+
+/* Completion statuses (subset of ibv_wc_status semantics). */
+enum {
+  TDR_WC_SUCCESS = 0,
+  TDR_WC_REM_ACCESS_ERR = 1, /* bad, out-of-range, or revoked rkey */
+  TDR_WC_LOC_ACCESS_ERR = 2, /* local MR invalid / recv too small */
+  TDR_WC_FLUSH_ERR = 3,      /* QP torn down with the op in flight */
+  TDR_WC_GENERAL_ERR = 4,
+};
+
+/* MR access flags (ibv_access_flags semantics). */
+enum {
+  TDR_ACCESS_LOCAL = 0,
+  TDR_ACCESS_REMOTE_WRITE = 1 << 0,
+  TDR_ACCESS_REMOTE_READ = 1 << 1,
+};
+
+/* Work-completion opcodes. */
+enum {
+  TDR_OP_WRITE = 0,
+  TDR_OP_READ = 1,
+  TDR_OP_SEND = 2,
+  TDR_OP_RECV = 3,
+};
+
+typedef struct {
+  uint64_t wr_id;
+  int32_t status; /* TDR_WC_* */
+  int32_t opcode; /* TDR_OP_* */
+  uint64_t len;   /* payload bytes (meaningful for RECV) */
+} tdr_wc;
+
+/* Last error message for the calling thread ("" if none). */
+const char *tdr_last_error(void);
+
+/* spec: "emu", "verbs", "verbs:<device>", or "auto" (verbs, else emu). */
+tdr_engine *tdr_engine_open(const char *spec);
+void tdr_engine_close(tdr_engine *e);
+int tdr_engine_kind(const tdr_engine *e);
+const char *tdr_engine_name(const tdr_engine *e);
+
+/* Registration. Mirrors the reference's acquire+get_pages+dma_map
+ * front-loading (amdp2p.c:112-264) collapsed into one call; dereg
+ * mirrors put_pages+release (amdp2p.c:283-313, 345-360). */
+tdr_mr *tdr_reg_mr(tdr_engine *e, void *addr, size_t len, int access);
+tdr_mr *tdr_reg_dmabuf_mr(tdr_engine *e, int fd, size_t offset, size_t len,
+                          uint64_t iova, int access);
+int tdr_dereg_mr(tdr_mr *mr);
+uint32_t tdr_mr_lkey(const tdr_mr *mr);
+uint32_t tdr_mr_rkey(const tdr_mr *mr);
+uint64_t tdr_mr_addr(const tdr_mr *mr);
+uint64_t tdr_mr_len(const tdr_mr *mr);
+
+/* Revocation: the free-while-registered flow (amdp2p.c:88-109). After
+ * this, remote access to the MR completes with TDR_WC_REM_ACCESS_ERR
+ * and local posts fail; dereg remains safe (idempotent teardown, the
+ * free_callback_called handshake of amdp2p.c:299-302). */
+int tdr_mr_invalidate(tdr_mr *mr);
+
+/* Connection bring-up over an out-of-band TCP rendezvous (the role
+ * perftest's TCP port plays). Blocking; one QP per call. */
+tdr_qp *tdr_listen(tdr_engine *e, const char *bind_host, int port);
+tdr_qp *tdr_connect(tdr_engine *e, const char *host, int port,
+                    int timeout_ms);
+int tdr_qp_close(tdr_qp *qp);
+
+/* Work posting. Returns 0 on success, -1 on immediate local failure.
+ * Completion (incl. remote status) arrives via tdr_poll. */
+int tdr_post_write(tdr_qp *qp, tdr_mr *lmr, size_t loff, uint64_t raddr,
+                   uint32_t rkey, size_t len, uint64_t wr_id);
+int tdr_post_read(tdr_qp *qp, tdr_mr *lmr, size_t loff, uint64_t raddr,
+                  uint32_t rkey, size_t len, uint64_t wr_id);
+int tdr_post_send(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t len,
+                  uint64_t wr_id);
+int tdr_post_recv(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t maxlen,
+                  uint64_t wr_id);
+
+/* Poll up to `max` completions; waits up to timeout_ms (0 = non-block,
+ * -1 = forever). Returns count, or -1 on error. */
+int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms);
+
+/* ------------------------------------------------------------------ *
+ * Ring allreduce — the cross-slice collective consumer (the layer the
+ * reference left to MPI/NCCL userspace, SURVEY.md §2 "Distributed
+ * communication backend inventory"). Classic reduce-scatter +
+ * all-gather over the neighbor QPs; per-rank traffic is
+ * 2*(world-1)/world of the buffer, the textbook bus-bandwidth-optimal
+ * schedule.
+ * ------------------------------------------------------------------ */
+
+typedef struct tdr_ring tdr_ring;
+
+enum {
+  TDR_DT_F32 = 0,
+  TDR_DT_F64 = 1,
+  TDR_DT_I32 = 2,
+  TDR_DT_I64 = 3,
+  TDR_DT_BF16 = 4, /* accumulated in f32 */
+};
+
+enum { TDR_RED_SUM = 0, TDR_RED_MAX = 1, TDR_RED_MIN = 2 };
+
+/* left/right: QPs to the ring neighbors (the same QP for world == 2).
+ * The ring borrows the QPs; it does not close them. */
+tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
+                          int rank, int world);
+int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
+                       int red_op);
+void tdr_ring_destroy(tdr_ring *r);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TDR_H_ */

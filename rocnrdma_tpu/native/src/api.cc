@@ -1,0 +1,126 @@
+// C API surface of libtdr — thin dispatch onto the backend classes.
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "common.h"
+#include "tdr/tdr.h"
+
+using tdr::Engine;
+using tdr::Mr;
+using tdr::Qp;
+
+extern "C" {
+
+const char *tdr_last_error(void) { return tdr::get_error(); }
+
+tdr_engine *tdr_engine_open(const char *spec) {
+  std::string s = spec ? spec : "auto";
+  std::string err;
+  Engine *e = nullptr;
+  if (s == "emu") {
+    e = tdr::create_emu_engine(&err);
+  } else if (s == "verbs" || s.rfind("verbs:", 0) == 0) {
+    std::string dev = s.size() > 6 ? s.substr(6) : "";
+    e = tdr::create_verbs_engine(dev, &err);
+  } else if (s == "auto") {
+    e = tdr::create_verbs_engine("", &err);
+    if (!e) e = tdr::create_emu_engine(&err);
+  } else {
+    tdr::set_error("unknown engine spec: " + s);
+    return nullptr;
+  }
+  if (!e) tdr::set_error("engine_open(" + s + "): " + err);
+  return reinterpret_cast<tdr_engine *>(e);
+}
+
+void tdr_engine_close(tdr_engine *e) { delete reinterpret_cast<Engine *>(e); }
+
+int tdr_engine_kind(const tdr_engine *e) {
+  return reinterpret_cast<const Engine *>(e)->kind();
+}
+
+const char *tdr_engine_name(const tdr_engine *e) {
+  return reinterpret_cast<const Engine *>(e)->name();
+}
+
+tdr_mr *tdr_reg_mr(tdr_engine *e, void *addr, size_t len, int access) {
+  return reinterpret_cast<tdr_mr *>(
+      reinterpret_cast<Engine *>(e)->reg_mr(addr, len, access));
+}
+
+tdr_mr *tdr_reg_dmabuf_mr(tdr_engine *e, int fd, size_t offset, size_t len,
+                          uint64_t iova, int access) {
+  return reinterpret_cast<tdr_mr *>(
+      reinterpret_cast<Engine *>(e)->reg_dmabuf_mr(fd, offset, len, iova,
+                                                   access));
+}
+
+int tdr_dereg_mr(tdr_mr *mr) {
+  Mr *m = reinterpret_cast<Mr *>(mr);
+  return m->engine->dereg_mr(m);
+}
+
+uint32_t tdr_mr_lkey(const tdr_mr *mr) {
+  return reinterpret_cast<const Mr *>(mr)->lkey;
+}
+uint32_t tdr_mr_rkey(const tdr_mr *mr) {
+  return reinterpret_cast<const Mr *>(mr)->rkey;
+}
+uint64_t tdr_mr_addr(const tdr_mr *mr) {
+  return reinterpret_cast<const Mr *>(mr)->addr;
+}
+uint64_t tdr_mr_len(const tdr_mr *mr) {
+  return reinterpret_cast<const Mr *>(mr)->len;
+}
+
+int tdr_mr_invalidate(tdr_mr *mr) {
+  return reinterpret_cast<Mr *>(mr)->invalidate();
+}
+
+tdr_qp *tdr_listen(tdr_engine *e, const char *bind_host, int port) {
+  return reinterpret_cast<tdr_qp *>(
+      reinterpret_cast<Engine *>(e)->listen(bind_host, port));
+}
+
+tdr_qp *tdr_connect(tdr_engine *e, const char *host, int port,
+                    int timeout_ms) {
+  return reinterpret_cast<tdr_qp *>(
+      reinterpret_cast<Engine *>(e)->connect(host, port, timeout_ms));
+}
+
+int tdr_qp_close(tdr_qp *qp) {
+  Qp *q = reinterpret_cast<Qp *>(qp);
+  delete q;  // dtor performs the close/flush
+  return 0;
+}
+
+int tdr_post_write(tdr_qp *qp, tdr_mr *lmr, size_t loff, uint64_t raddr,
+                   uint32_t rkey, size_t len, uint64_t wr_id) {
+  return reinterpret_cast<Qp *>(qp)->post_write(
+      reinterpret_cast<Mr *>(lmr), loff, raddr, rkey, len, wr_id);
+}
+
+int tdr_post_read(tdr_qp *qp, tdr_mr *lmr, size_t loff, uint64_t raddr,
+                  uint32_t rkey, size_t len, uint64_t wr_id) {
+  return reinterpret_cast<Qp *>(qp)->post_read(reinterpret_cast<Mr *>(lmr),
+                                               loff, raddr, rkey, len, wr_id);
+}
+
+int tdr_post_send(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t len,
+                  uint64_t wr_id) {
+  return reinterpret_cast<Qp *>(qp)->post_send(reinterpret_cast<Mr *>(lmr),
+                                               loff, len, wr_id);
+}
+
+int tdr_post_recv(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t maxlen,
+                  uint64_t wr_id) {
+  return reinterpret_cast<Qp *>(qp)->post_recv(reinterpret_cast<Mr *>(lmr),
+                                               loff, maxlen, wr_id);
+}
+
+int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms) {
+  return reinterpret_cast<Qp *>(qp)->poll(wc, max, timeout_ms);
+}
+
+}  // extern "C"

@@ -1,0 +1,76 @@
+// Internal engine interfaces shared by the emulated and verbs backends.
+#ifndef TDR_COMMON_H_
+#define TDR_COMMON_H_
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include "tdr/tdr.h"
+
+namespace tdr {
+
+// Thread-local error slot surfaced via tdr_last_error().
+void set_error(const std::string &msg);
+const char *get_error();
+
+class Engine;
+
+class Mr {
+ public:
+  virtual ~Mr() = default;
+  Engine *engine = nullptr;
+  uint64_t addr = 0;  // registered VA (or IOVA for dma-buf MRs)
+  uint64_t len = 0;
+  uint32_t lkey = 0;
+  uint32_t rkey = 0;
+  int access = 0;
+  std::atomic<bool> valid{true};
+  // Revoke: remote access must start failing immediately.
+  virtual int invalidate() = 0;
+};
+
+class Qp {
+ public:
+  virtual ~Qp() = default;
+  virtual int post_write(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
+                         size_t len, uint64_t wr_id) = 0;
+  virtual int post_read(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
+                        size_t len, uint64_t wr_id) = 0;
+  virtual int post_send(Mr *lmr, size_t loff, size_t len, uint64_t wr_id) = 0;
+  virtual int post_recv(Mr *lmr, size_t loff, size_t maxlen,
+                        uint64_t wr_id) = 0;
+  virtual int poll(tdr_wc *wc, int max, int timeout_ms) = 0;
+  virtual int close_qp() = 0;
+};
+
+class Engine {
+ public:
+  virtual ~Engine() = default;
+  virtual int kind() const = 0;
+  virtual const char *name() const = 0;
+  virtual Mr *reg_mr(void *addr, size_t len, int access) = 0;
+  virtual Mr *reg_dmabuf_mr(int fd, size_t offset, size_t len, uint64_t iova,
+                            int access) = 0;
+  virtual int dereg_mr(Mr *mr) = 0;
+  virtual Qp *listen(const char *bind_host, int port) = 0;
+  virtual Qp *connect(const char *host, int port, int timeout_ms) = 0;
+};
+
+Engine *create_emu_engine(std::string *err);
+Engine *create_verbs_engine(const std::string &device, std::string *err);
+
+// TCP helpers (bootstrap for both backends; data path for emu).
+int tcp_listen_accept(const char *bind_host, int port, std::string *err);
+int tcp_connect_retry(const char *host, int port, int timeout_ms,
+                      std::string *err);
+bool read_full(int fd, void *buf, size_t len);
+bool write_full(int fd, const void *buf, size_t len);
+bool write_hdr_payload(int fd, const void *hdr, size_t hdrlen,
+                       const void *payload, size_t len);
+void tune_socket(int fd);
+
+}  // namespace tdr
+
+#endif  // TDR_COMMON_H_

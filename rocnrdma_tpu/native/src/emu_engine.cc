@@ -1,0 +1,572 @@
+// Emulated RDMA backend: RC semantics over TCP, no hardware required.
+//
+// This is the "fake L2 backend" SURVEY.md §4 prescribes: the reference
+// could only be tested on a Fiji GPU + ConnectX HCA; this backend lets
+// the full registration → transfer → revocation lifecycle run anywhere.
+//
+// Model: each QP is one TCP connection plus a progress thread that
+// plays the HCA role on the passive side — it applies inbound RDMA
+// WRITEs directly into registered memory, serves READs out of it, and
+// generates completions. rkey checks happen remotely, exactly where a
+// real HCA checks its MTT: a revoked MR (tdr_mr_invalidate) makes
+// in-flight and future remote ops complete with REM_ACCESS_ERR, which
+// is how the reference's free-while-registered invalidation
+// (amdp2p.c:88-109) becomes observable to the peer.
+//
+// The caller's post path does no per-byte work besides the gathered
+// socket submission from the registered buffer itself (write_hdr_payload);
+// there is no intermediate staging copy in either direction.
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+
+namespace tdr {
+namespace {
+
+enum WireOp : uint8_t {
+  OP_WRITE = 1,
+  OP_WRITE_ACK = 2,
+  OP_READ_REQ = 3,
+  OP_READ_RESP = 4,
+  OP_SEND = 5,
+  OP_SEND_ACK = 6,
+  OP_GOODBYE = 7,
+};
+
+#pragma pack(push, 1)
+struct FrameHdr {
+  uint8_t op;
+  uint8_t status;
+  uint16_t pad;
+  uint32_t rkey;
+  uint64_t seq;
+  uint64_t raddr;
+  uint64_t len;
+};
+#pragma pack(pop)
+static_assert(sizeof(FrameHdr) == 32, "wire format");
+
+class EmuEngine;
+
+class EmuMr : public Mr {
+ public:
+  EmuEngine *eng = nullptr;
+  void *mapped = nullptr;  // dma-buf mmap base (owned), else null
+  size_t maplen = 0;
+  // In-flight remote accesses ("NIC" DMA in progress). dereg blocks on
+  // this reaching zero, matching ibv_dereg_mr's guarantee that the NIC
+  // never touches the memory after dereg returns.
+  std::atomic<int> inflight{0};
+  int invalidate() override {
+    valid.store(false, std::memory_order_release);
+    return 0;
+  }
+  ~EmuMr() override {
+    if (mapped) munmap(mapped, maplen);
+  }
+};
+
+class EmuQp;
+
+class EmuEngine : public Engine {
+ public:
+  int kind() const override { return TDR_ENGINE_EMU; }
+  const char *name() const override { return "emu"; }
+
+  Mr *reg_mr(void *addr, size_t len, int access) override {
+    if (!addr || len == 0) {
+      set_error("reg_mr: null addr or zero len");
+      return nullptr;
+    }
+    auto *mr = new EmuMr();
+    mr->engine = this;
+    mr->eng = this;
+    mr->addr = reinterpret_cast<uint64_t>(addr);
+    mr->len = len;
+    mr->access = access;
+    std::lock_guard<std::mutex> g(mu_);
+    mr->lkey = mr->rkey = next_key_++;
+    mrs_[mr->rkey] = mr;
+    return mr;
+  }
+
+  // Emulated dma-buf path: mmap the fd so the "device" memory behind it
+  // is addressable, then register the mapping. On the verbs backend the
+  // same API goes to ibv_reg_dmabuf_mr with no CPU mapping at all.
+  Mr *reg_dmabuf_mr(int fd, size_t offset, size_t len, uint64_t iova,
+                    int access) override {
+    if (len == 0) {
+      set_error("reg_dmabuf_mr: zero len");
+      return nullptr;
+    }
+    long pagesz = sysconf(_SC_PAGESIZE);
+    size_t map_off = offset & ~static_cast<size_t>(pagesz - 1);
+    size_t head = offset - map_off;
+    void *m = mmap(nullptr, len + head, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, static_cast<off_t>(map_off));
+    if (m == MAP_FAILED) {
+      set_error(std::string("reg_dmabuf_mr: mmap: ") + strerror(errno));
+      return nullptr;
+    }
+    auto *mr = new EmuMr();
+    mr->engine = this;
+    mr->eng = this;
+    mr->mapped = m;
+    mr->maplen = len + head;
+    char *base = static_cast<char *>(m) + head;
+    // The MR's address space is the IOVA the caller chose (defaulting
+    // to the CPU mapping), so remote raddr arithmetic works the same
+    // way as for plain MRs.
+    mr->addr = iova ? iova : reinterpret_cast<uint64_t>(base);
+    mr->len = len;
+    mr->access = access;
+    std::lock_guard<std::mutex> g(mu_);
+    mr->lkey = mr->rkey = next_key_++;
+    mrs_[mr->rkey] = mr;
+    cpu_base_[mr->rkey] = base;
+    return mr;
+  }
+
+  int dereg_mr(Mr *mr) override {
+    auto *emr = static_cast<EmuMr *>(mr);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      mrs_.erase(mr->rkey);  // no new resolves from here on
+      cpu_base_.erase(mr->rkey);
+    }
+    // Wait out in-flight "DMA" before freeing — ibv_dereg_mr semantics.
+    while (emr->inflight.load(std::memory_order_acquire) > 0)
+      std::this_thread::yield();
+    delete emr;
+    return 0;
+  }
+
+  // Resolve (rkey, raddr, len) to a CPU pointer, enforcing validity,
+  // access rights, and bounds — the emulated MTT lookup. On success the
+  // MR's inflight count is raised; caller must dma_done(mr) after I/O.
+  char *resolve(uint32_t rkey, uint64_t raddr, uint64_t len, int need_access,
+                EmuMr **out_mr) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = mrs_.find(rkey);
+    if (it == mrs_.end()) return nullptr;
+    EmuMr *mr = it->second;
+    if (!mr->valid.load(std::memory_order_acquire)) return nullptr;
+    if (need_access && !(mr->access & need_access)) return nullptr;
+    if (raddr < mr->addr || len > mr->len ||
+        raddr - mr->addr > mr->len - len)
+      return nullptr;
+    uint64_t off = raddr - mr->addr;
+    auto cb = cpu_base_.find(rkey);
+    char *base = (cb != cpu_base_.end())
+                     ? cb->second
+                     : reinterpret_cast<char *>(mr->addr);
+    mr->inflight.fetch_add(1, std::memory_order_acq_rel);
+    *out_mr = mr;
+    return base + off;
+  }
+
+  static void dma_done(EmuMr *mr) {
+    if (mr) mr->inflight.fetch_sub(1, std::memory_order_acq_rel);
+  }
+
+  // Local-side resolve for the posting path (lkey semantics).
+  char *local_ptr(Mr *mr, size_t loff, size_t len) {
+    if (!mr->valid.load(std::memory_order_acquire)) return nullptr;
+    if (loff > mr->len || len > mr->len - loff) return nullptr;
+    std::lock_guard<std::mutex> g(mu_);
+    auto cb = cpu_base_.find(mr->rkey);
+    char *base = (cb != cpu_base_.end())
+                     ? cb->second
+                     : reinterpret_cast<char *>(mr->addr);
+    return base + loff;
+  }
+
+  Qp *listen(const char *bind_host, int port) override;
+  Qp *connect(const char *host, int port, int timeout_ms) override;
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<uint32_t, EmuMr *> mrs_;
+  std::unordered_map<uint32_t, char *> cpu_base_;  // dma-buf MRs only
+  uint32_t next_key_ = 0x1000;
+};
+
+struct PendingOp {
+  uint64_t wr_id;
+  int opcode;     // TDR_OP_*
+  char *dst;      // READ destination
+  uint64_t len;
+};
+
+struct PostedRecv {
+  uint64_t wr_id;
+  char *dst;
+  uint64_t maxlen;
+};
+
+class EmuQp : public Qp {
+ public:
+  EmuQp(EmuEngine *eng, int fd) : eng_(eng), fd_(fd) {
+    progress_ = std::thread([this] { progress_loop(); });
+  }
+
+  ~EmuQp() override {
+    close_qp();
+    if (progress_.joinable()) progress_.join();
+  }
+
+  int post_write(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
+                 size_t len, uint64_t wr_id) override {
+    char *src = eng_->local_ptr(lmr, loff, len);
+    if (!src) {
+      set_error("post_write: invalid local MR range");
+      return -1;
+    }
+    FrameHdr h{};
+    h.op = OP_WRITE;
+    h.rkey = rkey;
+    h.raddr = raddr;
+    h.len = len;
+    h.seq = new_pending(wr_id, TDR_OP_WRITE, nullptr, len);
+    if (!send_frame(h, src, len)) return fail_pending(h.seq);
+    return 0;
+  }
+
+  int post_read(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
+                size_t len, uint64_t wr_id) override {
+    char *dst = eng_->local_ptr(lmr, loff, len);
+    if (!dst) {
+      set_error("post_read: invalid local MR range");
+      return -1;
+    }
+    FrameHdr h{};
+    h.op = OP_READ_REQ;
+    h.rkey = rkey;
+    h.raddr = raddr;
+    h.len = len;
+    h.seq = new_pending(wr_id, TDR_OP_READ, dst, len);
+    if (!send_frame(h, nullptr, 0)) return fail_pending(h.seq);
+    return 0;
+  }
+
+  int post_send(Mr *lmr, size_t loff, size_t len, uint64_t wr_id) override {
+    char *src = eng_->local_ptr(lmr, loff, len);
+    if (!src) {
+      set_error("post_send: invalid local MR range");
+      return -1;
+    }
+    FrameHdr h{};
+    h.op = OP_SEND;
+    h.len = len;
+    h.seq = new_pending(wr_id, TDR_OP_SEND, nullptr, len);
+    if (!send_frame(h, src, len)) return fail_pending(h.seq);
+    return 0;
+  }
+
+  int post_recv(Mr *lmr, size_t loff, size_t maxlen, uint64_t wr_id) override {
+    char *dst = eng_->local_ptr(lmr, loff, maxlen);
+    if (!dst) {
+      set_error("post_recv: invalid local MR range");
+      return -1;
+    }
+    std::unique_lock<std::mutex> lk(mu_);
+    // Unexpected-message queue: a SEND that raced ahead of the recv
+    // post was buffered by the progress thread; consume it now.
+    if (!unexpected_.empty()) {
+      std::vector<char> payload = std::move(unexpected_.front());
+      unexpected_.pop_front();
+      lk.unlock();
+      if (payload.size() > maxlen) {
+        push_wc({wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, payload.size()});
+        return 0;
+      }
+      memcpy(dst, payload.data(), payload.size());
+      push_wc({wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, payload.size()});
+      return 0;
+    }
+    recvs_.push_back({wr_id, dst, maxlen});
+    return 0;
+  }
+
+  int poll(tdr_wc *wc, int max, int timeout_ms) override {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (cq_.empty() && timeout_ms != 0) {
+      auto pred = [this] { return !cq_.empty() || dead_; };
+      if (timeout_ms < 0)
+        cv_.wait(lk, pred);
+      else
+        cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+    }
+    int n = 0;
+    while (n < max && !cq_.empty()) {
+      wc[n++] = cq_.front();
+      cq_.pop_front();
+    }
+    return n;
+  }
+
+  int close_qp() override {
+    bool expected = false;
+    if (!closing_.compare_exchange_strong(expected, true)) return 0;
+    FrameHdr h{};
+    h.op = OP_GOODBYE;
+    send_frame(h, nullptr, 0);
+    ::shutdown(fd_, SHUT_RDWR);
+    return 0;
+  }
+
+ private:
+  uint64_t new_pending(uint64_t wr_id, int opcode, char *dst, uint64_t len) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint64_t seq = next_seq_++;
+    pending_[seq] = {wr_id, opcode, dst, len};
+    return seq;
+  }
+
+  int fail_pending(uint64_t seq) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pending_.find(seq);
+    if (it != pending_.end()) {
+      cq_.push_back({it->second.wr_id, TDR_WC_FLUSH_ERR,
+                     it->second.opcode, 0});
+      pending_.erase(it);
+      cv_.notify_all();
+    }
+    set_error("post: connection down");
+    return -1;
+  }
+
+  bool send_frame(const FrameHdr &h, const void *payload, size_t len) {
+    std::lock_guard<std::mutex> g(send_mu_);
+    if (payload && len)
+      return write_hdr_payload(fd_, &h, sizeof(h), payload, len);
+    return write_full(fd_, &h, sizeof(h));
+  }
+
+  void push_wc(tdr_wc wc) {
+    std::lock_guard<std::mutex> g(mu_);
+    cq_.push_back(wc);
+    cv_.notify_all();
+  }
+
+  // Drain len payload bytes we cannot place (bad rkey etc.).
+  bool drain(uint64_t len) {
+    char scratch[65536];
+    while (len > 0) {
+      size_t chunk = len < sizeof(scratch) ? len : sizeof(scratch);
+      if (!read_full(fd_, scratch, chunk)) return false;
+      len -= chunk;
+    }
+    return true;
+  }
+
+  void progress_loop() {
+    FrameHdr h;
+    while (read_full(fd_, &h, sizeof(h))) {
+      switch (h.op) {
+        case OP_WRITE: {
+          EmuMr *tmr = nullptr;
+          char *dst = eng_->resolve(h.rkey, h.raddr, h.len,
+                                    TDR_ACCESS_REMOTE_WRITE, &tmr);
+          FrameHdr ack{};
+          ack.op = OP_WRITE_ACK;
+          ack.seq = h.seq;
+          if (dst) {
+            bool ok = read_full(fd_, dst, h.len);
+            EmuEngine::dma_done(tmr);
+            if (!ok) goto out;
+            ack.status = TDR_WC_SUCCESS;
+          } else {
+            if (!drain(h.len)) goto out;
+            ack.status = TDR_WC_REM_ACCESS_ERR;
+          }
+          if (!send_frame(ack, nullptr, 0)) goto out;
+          break;
+        }
+        case OP_READ_REQ: {
+          EmuMr *tmr = nullptr;
+          char *src = eng_->resolve(h.rkey, h.raddr, h.len,
+                                    TDR_ACCESS_REMOTE_READ, &tmr);
+          FrameHdr resp{};
+          resp.op = OP_READ_RESP;
+          resp.seq = h.seq;
+          if (src) {
+            resp.status = TDR_WC_SUCCESS;
+            resp.len = h.len;
+            bool ok = send_frame(resp, src, h.len);
+            EmuEngine::dma_done(tmr);
+            if (!ok) goto out;
+          } else {
+            resp.status = TDR_WC_REM_ACCESS_ERR;
+            resp.len = 0;
+            if (!send_frame(resp, nullptr, 0)) goto out;
+          }
+          break;
+        }
+        case OP_SEND: {
+          PostedRecv r{};
+          bool have = false;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            if (!recvs_.empty()) {
+              r = recvs_.front();
+              recvs_.pop_front();
+              have = true;
+            }
+          }
+          FrameHdr ack{};
+          ack.op = OP_SEND_ACK;
+          ack.seq = h.seq;
+          ack.status = TDR_WC_SUCCESS;
+          if (have) {
+            if (h.len <= r.maxlen) {
+              if (!read_full(fd_, r.dst, h.len)) goto out;
+              push_wc({r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, h.len});
+            } else {
+              if (!drain(h.len)) goto out;
+              push_wc({r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
+            }
+          } else {
+            std::vector<char> buf(h.len);
+            if (h.len && !read_full(fd_, buf.data(), h.len)) goto out;
+            // Re-check under the lock: a recv may have been posted
+            // while we were reading the payload (it saw unexpected_
+            // empty and queued itself); deliver rather than strand it.
+            PostedRecv r2{};
+            bool have2 = false;
+            {
+              std::lock_guard<std::mutex> g(mu_);
+              if (!recvs_.empty()) {
+                r2 = recvs_.front();
+                recvs_.pop_front();
+                have2 = true;
+              } else {
+                unexpected_.push_back(std::move(buf));
+              }
+            }
+            if (have2) {
+              if (buf.size() <= r2.maxlen) {
+                memcpy(r2.dst, buf.data(), buf.size());
+                push_wc({r2.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, buf.size()});
+              } else {
+                push_wc({r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV,
+                         buf.size()});
+              }
+            }
+          }
+          if (!send_frame(ack, nullptr, 0)) goto out;
+          break;
+        }
+        case OP_WRITE_ACK:
+        case OP_SEND_ACK: {
+          complete_pending(h.seq, h.status, nullptr, 0);
+          break;
+        }
+        case OP_READ_RESP: {
+          char *dst = nullptr;
+          uint64_t want = 0;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            auto it = pending_.find(h.seq);
+            if (it != pending_.end()) {
+              dst = it->second.dst;
+              want = it->second.len;
+            }
+          }
+          if (h.status == TDR_WC_SUCCESS && h.len) {
+            if (dst && h.len == want) {
+              if (!read_full(fd_, dst, h.len)) goto out;
+            } else {
+              if (!drain(h.len)) goto out;
+            }
+          }
+          complete_pending(h.seq, h.status, nullptr, 0);
+          break;
+        }
+        case OP_GOODBYE:
+          goto out;
+        default:
+          goto out;
+      }
+    }
+  out:
+    // Connection gone: flush every in-flight op and pending recv, the
+    // RC flush semantics (TDR_WC_FLUSH_ERR).
+    std::lock_guard<std::mutex> g(mu_);
+    dead_ = true;
+    for (auto &kv : pending_)
+      cq_.push_back({kv.second.wr_id, TDR_WC_FLUSH_ERR, kv.second.opcode, 0});
+    pending_.clear();
+    for (auto &r : recvs_)
+      cq_.push_back({r.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0});
+    recvs_.clear();
+    cv_.notify_all();
+  }
+
+  void complete_pending(uint64_t seq, uint8_t status, char *, uint64_t) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pending_.find(seq);
+    if (it == pending_.end()) return;
+    cq_.push_back({it->second.wr_id, status, it->second.opcode,
+                   it->second.len});
+    pending_.erase(it);
+    cv_.notify_all();
+  }
+
+  EmuEngine *eng_;
+  int fd_;
+  std::thread progress_;
+  std::atomic<bool> closing_{false};
+
+  std::mutex send_mu_;  // serializes frame submission on the socket
+
+  std::mutex mu_;  // guards cq_, pending_, recvs_, unexpected_
+  std::condition_variable cv_;
+  std::deque<tdr_wc> cq_;
+  std::unordered_map<uint64_t, PendingOp> pending_;
+  std::deque<PostedRecv> recvs_;
+  std::deque<std::vector<char>> unexpected_;
+  uint64_t next_seq_ = 1;
+  bool dead_ = false;
+};
+
+Qp *EmuEngine::listen(const char *bind_host, int port) {
+  std::string err;
+  int fd = tcp_listen_accept(bind_host, port, &err);
+  if (fd < 0) {
+    set_error("listen: " + err);
+    return nullptr;
+  }
+  return new EmuQp(this, fd);
+}
+
+Qp *EmuEngine::connect(const char *host, int port, int timeout_ms) {
+  std::string err;
+  int fd = tcp_connect_retry(host, port, timeout_ms, &err);
+  if (fd < 0) {
+    set_error("connect: " + err);
+    return nullptr;
+  }
+  return new EmuQp(this, fd);
+}
+
+}  // namespace
+
+Engine *create_emu_engine(std::string *err) {
+  (void)err;
+  return new EmuEngine();
+}
+
+}  // namespace tdr

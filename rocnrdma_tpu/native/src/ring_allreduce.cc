@@ -1,0 +1,296 @@
+// Ring allreduce over the engine: reduce-scatter + all-gather.
+//
+// The reference stops at the transport (its consumers were MPI apps on
+// IB Verbs, README.md:64); this file is the in-framework consumer that
+// BASELINE.md configs 3-4 require — the collective that cross-slice
+// gradient sync rides. Buffers are registered once per (buffer, ring)
+// pair and cached, preserving the reference's front-loaded-registration
+// invariant: steady-state steps post work requests only.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "tdr/tdr.h"
+
+namespace {
+
+size_t dtype_size(int dt) {
+  switch (dt) {
+    case TDR_DT_F32:
+    case TDR_DT_I32:
+      return 4;
+    case TDR_DT_F64:
+    case TDR_DT_I64:
+      return 8;
+    case TDR_DT_BF16:
+      return 2;
+    default:
+      return 0;
+  }
+}
+
+float bf16_to_f32(uint16_t v) {
+  uint32_t u = static_cast<uint32_t>(v) << 16;
+  float f;
+  memcpy(&f, &u, 4);
+  return f;
+}
+
+uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  memcpy(&u, &f, 4);
+  // round-to-nearest-even, matching TPU bf16 semantics
+  uint32_t rounding = 0x7fff + ((u >> 16) & 1);
+  return static_cast<uint16_t>((u + rounding) >> 16);
+}
+
+template <typename T>
+void reduce_typed(T *dst, const T *src, size_t n, int op) {
+  switch (op) {
+    case TDR_RED_SUM:
+      for (size_t i = 0; i < n; i++) dst[i] += src[i];
+      break;
+    case TDR_RED_MAX:
+      for (size_t i = 0; i < n; i++)
+        if (src[i] > dst[i]) dst[i] = src[i];
+      break;
+    case TDR_RED_MIN:
+      for (size_t i = 0; i < n; i++)
+        if (src[i] < dst[i]) dst[i] = src[i];
+      break;
+  }
+}
+
+void reduce_bf16(uint16_t *dst, const uint16_t *src, size_t n, int op) {
+  for (size_t i = 0; i < n; i++) {
+    float a = bf16_to_f32(dst[i]), b = bf16_to_f32(src[i]);
+    float r = a;
+    switch (op) {
+      case TDR_RED_SUM:
+        r = a + b;
+        break;
+      case TDR_RED_MAX:
+        r = b > a ? b : a;
+        break;
+      case TDR_RED_MIN:
+        r = b < a ? b : a;
+        break;
+    }
+    dst[i] = f32_to_bf16(r);
+  }
+}
+
+void reduce_any(void *dst, const void *src, size_t n, int dt, int op) {
+  switch (dt) {
+    case TDR_DT_F32:
+      reduce_typed(static_cast<float *>(dst), static_cast<const float *>(src),
+                   n, op);
+      break;
+    case TDR_DT_F64:
+      reduce_typed(static_cast<double *>(dst),
+                   static_cast<const double *>(src), n, op);
+      break;
+    case TDR_DT_I32:
+      reduce_typed(static_cast<int32_t *>(dst),
+                   static_cast<const int32_t *>(src), n, op);
+      break;
+    case TDR_DT_I64:
+      reduce_typed(static_cast<int64_t *>(dst),
+                   static_cast<const int64_t *>(src), n, op);
+      break;
+    case TDR_DT_BF16:
+      reduce_bf16(static_cast<uint16_t *>(dst),
+                  static_cast<const uint16_t *>(src), n, op);
+      break;
+  }
+}
+
+}  // namespace
+
+struct tdr_ring {
+  tdr_engine *eng;
+  tdr_qp *left;   // receive from
+  tdr_qp *right;  // send to
+  int rank;
+  int world;
+  std::vector<char> tmp;
+  tdr_mr *tmp_mr = nullptr;
+  // Registration cache: (base, len) -> MR. Front-loads reg cost.
+  std::unordered_map<uint64_t, tdr_mr *> mr_cache;
+  std::mutex mu;
+
+  tdr_mr *data_mr(void *base, size_t len) {
+    uint64_t key = reinterpret_cast<uint64_t>(base);
+    auto it = mr_cache.find(key);
+    if (it != mr_cache.end() && tdr_mr_len(it->second) >= len)
+      return it->second;
+    if (it != mr_cache.end()) {
+      tdr_dereg_mr(it->second);
+      mr_cache.erase(it);
+    }
+    tdr_mr *mr = tdr_reg_mr(eng, base, len, 0);
+    if (mr) mr_cache[key] = mr;
+    return mr;
+  }
+
+  tdr_mr *scratch(size_t len) {
+    if (tmp.size() < len || !tmp_mr) {
+      if (tmp_mr) {
+        tdr_dereg_mr(tmp_mr);
+        tmp_mr = nullptr;
+      }
+      tmp.resize(len);
+      tmp_mr = tdr_reg_mr(eng, tmp.data(), tmp.size(), 0);
+    }
+    return tmp_mr;
+  }
+};
+
+extern "C" {
+
+tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
+                          int rank, int world) {
+  if (!e || !left || !right || world < 2 || rank < 0 || rank >= world) {
+    tdr::set_error("ring_create: bad topology");
+    return nullptr;
+  }
+  auto *r = new tdr_ring();
+  r->eng = e;
+  r->left = left;
+  r->right = right;
+  r->rank = rank;
+  r->world = world;
+  return r;
+}
+
+void tdr_ring_destroy(tdr_ring *r) {
+  if (!r) return;
+  for (auto &kv : r->mr_cache) tdr_dereg_mr(kv.second);
+  if (r->tmp_mr) tdr_dereg_mr(r->tmp_mr);
+  delete r;
+}
+
+// Wait for one completion with the given wr_id on qp; other completions
+// arriving first are held by the caller loop (each step has at most one
+// outstanding send + one recv per QP, so a two-slot check suffices).
+static int wait_wr(tdr_qp *qp, uint64_t want_a, uint64_t want_b, int *got_a,
+                   int *got_b) {
+  while (!(*got_a && *got_b)) {
+    tdr_wc wc[2];
+    int n = tdr_poll(qp, wc, 2, 30000);
+    if (n <= 0) {
+      tdr::set_error("ring: poll timeout/failure");
+      return -1;
+    }
+    for (int i = 0; i < n; i++) {
+      if (wc[i].status != TDR_WC_SUCCESS) {
+        tdr::set_error("ring: completion error status " +
+                       std::to_string(wc[i].status));
+        return -1;
+      }
+      if (wc[i].wr_id == want_a) *got_a = 1;
+      if (wc[i].wr_id == want_b) *got_b = 1;
+    }
+  }
+  return 0;
+}
+
+int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
+                       int red_op) {
+  if (!r || !data) {
+    tdr::set_error("ring_allreduce: null ring or data");
+    return -1;
+  }
+  size_t esz = dtype_size(dtype);
+  if (esz == 0) {
+    tdr::set_error("ring: bad dtype");
+    return -1;
+  }
+  if (count == 0) return 0;
+  std::lock_guard<std::mutex> g(r->mu);
+  const int world = r->world;
+  const size_t nbytes = count * esz;
+
+  // Segment layout: world segments, first `rem` get one extra element.
+  std::vector<size_t> seg_off(world), seg_len(world);
+  size_t base = count / world, rem = count % world;
+  size_t off = 0;
+  for (int i = 0; i < world; i++) {
+    seg_off[i] = off * esz;
+    seg_len[i] = (base + (static_cast<size_t>(i) < rem ? 1 : 0)) * esz;
+    off += base + (static_cast<size_t>(i) < rem ? 1 : 0);
+  }
+  size_t max_seg = 0;
+  for (int i = 0; i < world; i++)
+    if (seg_len[i] > max_seg) max_seg = seg_len[i];
+
+  tdr_mr *dmr = r->data_mr(data, nbytes);
+  tdr_mr *tmr = max_seg ? r->scratch(max_seg) : nullptr;
+  if (!dmr || (max_seg && !tmr)) return -1;
+
+  char *cdata = static_cast<char *>(data);
+  const bool same_qp = (r->left == r->right);
+  const uint64_t WR_SEND = 0x53454e44, WR_RECV = 0x52454356;
+
+  // Phase 1: reduce-scatter. After step s, segment (rank-s-1) holds the
+  // partial sum of s+2 ranks; after world-1 steps each rank owns the
+  // full reduction of segment (rank+1) mod world.
+  for (int s = 0; s < world - 1; s++) {
+    int send_seg = ((r->rank - s) % world + world) % world;
+    int recv_seg = ((r->rank - s - 1) % world + world) % world;
+    if (seg_len[recv_seg] &&
+        tdr_post_recv(r->left, tmr, 0, seg_len[recv_seg], WR_RECV) != 0)
+      return -1;
+    if (seg_len[send_seg] &&
+        tdr_post_send(r->right, dmr, seg_off[send_seg], seg_len[send_seg],
+                      WR_SEND) != 0)
+      return -1;
+    int got_s = seg_len[send_seg] ? 0 : 1, got_r = seg_len[recv_seg] ? 0 : 1;
+    if (same_qp) {
+      if (wait_wr(r->left, WR_SEND, WR_RECV, &got_s, &got_r) != 0) return -1;
+    } else {
+      int one = 1;
+      if (!got_r && wait_wr(r->left, WR_RECV, WR_RECV, &got_r, &one) != 0)
+        return -1;
+      one = 1;
+      if (!got_s && wait_wr(r->right, WR_SEND, WR_SEND, &got_s, &one) != 0)
+        return -1;
+    }
+    if (seg_len[recv_seg])
+      reduce_any(cdata + seg_off[recv_seg], r->tmp.data(),
+                 seg_len[recv_seg] / esz, dtype, red_op);
+  }
+
+  // Phase 2: all-gather — fully-reduced segments circulate; received
+  // bytes land directly in the data MR (no scratch, no extra copy).
+  for (int s = 0; s < world - 1; s++) {
+    int send_seg = ((r->rank + 1 - s) % world + world) % world;
+    int recv_seg = ((r->rank - s) % world + world) % world;
+    if (seg_len[recv_seg] &&
+        tdr_post_recv(r->left, dmr, seg_off[recv_seg], seg_len[recv_seg],
+                      WR_RECV) != 0)
+      return -1;
+    if (seg_len[send_seg] &&
+        tdr_post_send(r->right, dmr, seg_off[send_seg], seg_len[send_seg],
+                      WR_SEND) != 0)
+      return -1;
+    int got_s = seg_len[send_seg] ? 0 : 1, got_r = seg_len[recv_seg] ? 0 : 1;
+    if (same_qp) {
+      if (wait_wr(r->left, WR_SEND, WR_RECV, &got_s, &got_r) != 0) return -1;
+    } else {
+      int one = 1;
+      if (!got_r && wait_wr(r->left, WR_RECV, WR_RECV, &got_r, &one) != 0)
+        return -1;
+      one = 1;
+      if (!got_s && wait_wr(r->right, WR_SEND, WR_SEND, &got_s, &one) != 0)
+        return -1;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

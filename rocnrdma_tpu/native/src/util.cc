@@ -1,0 +1,167 @@
+// Socket plumbing + thread-local error slot.
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
+#include "common.h"
+
+namespace tdr {
+
+static thread_local std::string g_error;
+
+void set_error(const std::string &msg) { g_error = msg; }
+const char *get_error() { return g_error.c_str(); }
+
+void tune_socket(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int buf = 8 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+}
+
+static bool make_addr(const char *host, int port, sockaddr_in *out,
+                      std::string *err) {
+  memset(out, 0, sizeof(*out));
+  out->sin_family = AF_INET;
+  out->sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &out->sin_addr) != 1) {
+    if (err) *err = std::string("bad IPv4 address: ") + host;
+    return false;
+  }
+  return true;
+}
+
+int tcp_listen_accept(const char *bind_host, int port, std::string *err) {
+  sockaddr_in addr;
+  if (!make_addr(bind_host, port, &addr, err)) return -1;
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) {
+    if (err) *err = std::string("socket: ") + strerror(errno);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  if (bind(lfd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) < 0 ||
+      ::listen(lfd, 1) < 0) {
+    if (err) *err = std::string("bind/listen: ") + strerror(errno);
+    close(lfd);
+    return -1;
+  }
+  int fd = accept(lfd, nullptr, nullptr);
+  int saved = errno;
+  close(lfd);
+  if (fd < 0) {
+    if (err) *err = std::string("accept: ") + strerror(saved);
+    return -1;
+  }
+  tune_socket(fd);
+  return fd;
+}
+
+int tcp_connect_retry(const char *host, int port, int timeout_ms,
+                      std::string *err) {
+  sockaddr_in addr;
+  if (!make_addr(host, port, &addr, err)) return -1;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 0);
+  for (;;) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) {
+      if (err) *err = std::string("socket: ") + strerror(errno);
+      return -1;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof(addr)) ==
+        0) {
+      tune_socket(fd);
+      return fd;
+    }
+    close(fd);
+    if (timeout_ms >= 0 && std::chrono::steady_clock::now() >= deadline) {
+      if (err)
+        *err = std::string("connect timeout to ") + host + ":" +
+               std::to_string(port);
+      return -1;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+}
+
+bool read_full(int fd, void *buf, size_t len) {
+  char *p = static_cast<char *>(buf);
+  while (len > 0) {
+    ssize_t n = ::read(fd, p, len);
+    if (n == 0) return false;  // EOF
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void *buf, size_t len) {
+  const char *p = static_cast<const char *>(buf);
+  while (len > 0) {
+    ssize_t n = ::write(fd, p, len);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// Header + payload in one gathered submission so the payload bytes go
+// straight from the registered memory to the socket — the emulated
+// analogue of the NIC reading the MR directly (no bounce buffer).
+bool write_hdr_payload(int fd, const void *hdr, size_t hdrlen,
+                       const void *payload, size_t len) {
+  iovec iov[2];
+  iov[0].iov_base = const_cast<void *>(hdr);
+  iov[0].iov_len = hdrlen;
+  iov[1].iov_base = const_cast<void *>(payload);
+  iov[1].iov_len = len;
+  size_t total = hdrlen + len;
+  size_t sent = 0;
+  int iovidx = 0;
+  while (sent < total) {
+    msghdr msg;
+    memset(&msg, 0, sizeof(msg));
+    msg.msg_iov = &iov[iovidx];
+    msg.msg_iovlen = 2 - iovidx;
+    ssize_t n = sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    sent += static_cast<size_t>(n);
+    size_t adv = static_cast<size_t>(n);
+    while (adv > 0 && iovidx < 2) {
+      if (adv >= iov[iovidx].iov_len) {
+        adv -= iov[iovidx].iov_len;
+        iov[iovidx].iov_len = 0;
+        iovidx++;
+      } else {
+        iov[iovidx].iov_base = static_cast<char *>(iov[iovidx].iov_base) + adv;
+        iov[iovidx].iov_len -= adv;
+        adv = 0;
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace tdr

@@ -1,0 +1,54 @@
+"""Runtime configuration.
+
+The reference has build-time knobs only (``Makefile:2-3``,
+``tests/Makefile:1-15``) and no module parameters; here every knob is a
+runtime env var with a typed accessor so tests and the bench harness can
+steer backend selection without rebuilds.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+def env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("0", "false", "no", "")
+
+
+@dataclass
+class Config:
+    # Engine spec: "auto" tries verbs then falls back to emu.
+    engine: str = "auto"
+    # TCP bootstrap rendezvous defaults (mirrors perftest's -p).
+    bootstrap_host: str = "127.0.0.1"
+    bootstrap_port: int = 18515
+    # Ring-allreduce chunking granularity in bytes.
+    allreduce_chunk: int = 1 << 20
+    # Hard cap on host-staged bytes for the "zero host staging" check
+    # (BASELINE.md config 3). -1 = unlimited.
+    max_staging_bytes: int = -1
+
+
+def get_config() -> Config:
+    # Env vars are read here, at call time, so overrides set after
+    # import (tests, bench harnesses) take effect.
+    return Config(
+        engine=env_str("TDR_ENGINE", "auto"),
+        bootstrap_host=env_str("TDR_BOOTSTRAP_HOST", "127.0.0.1"),
+        bootstrap_port=env_int("TDR_BOOTSTRAP_PORT", 18515),
+        allreduce_chunk=env_int("TDR_ALLREDUCE_CHUNK", 1 << 20),
+        max_staging_bytes=env_int("TDR_MAX_STAGING_BYTES", -1),
+    )

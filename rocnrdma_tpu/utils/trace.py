@@ -1,0 +1,76 @@
+"""Structured tracing.
+
+The reference's only observability is printk macro families with a
+driver-name prefix (``amdp2p.c:57-64``, ``tests/amdp2ptest.c:68-73``),
+toggled via dynamic debug. Here tracing is structured from the start:
+named scopes, per-event counters, and an in-memory ring readable by
+tests — so pass/fail never depends on a human reading dmesg
+(SURVEY.md §4's main criticism of the reference).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, Iterator, List, Tuple
+from contextlib import contextmanager
+
+_LOG = logging.getLogger("rocnrdma_tpu")
+if os.environ.get("TDR_DEBUG"):
+    logging.basicConfig(level=logging.DEBUG)
+    _LOG.setLevel(logging.DEBUG)
+
+_RING_CAP = 4096
+
+
+class _Tracer:
+    """Process-wide event tracer: counters + bounded event ring."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = collections.defaultdict(int)
+        self._ring: Deque[Tuple[float, str, Dict[str, Any]]] = collections.deque(
+            maxlen=_RING_CAP
+        )
+
+    def event(self, name: str, **fields: Any) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._counters[name] += 1
+            self._ring.append((now, name, fields))
+        if _LOG.isEnabledFor(logging.DEBUG):
+            _LOG.debug("%s %s", name, fields)
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def events(self, name: str | None = None) -> List[Tuple[float, str, Dict[str, Any]]]:
+        with self._lock:
+            evs = list(self._ring)
+        if name is None:
+            return evs
+        return [e for e in evs if e[1] == name]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._ring.clear()
+
+    @contextmanager
+    def span(self, name: str, **fields: Any) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.event(name, dur_s=time.monotonic() - t0, **fields)
+
+
+trace = _Tracer()

@@ -1,0 +1,29 @@
+"""Test configuration.
+
+All tests run hardware-free: JAX is forced onto a virtual 8-device CPU
+platform (the multi-chip sharding story is validated on a virtual mesh,
+mirroring how the driver's ``dryrun_multichip`` runs), and the transport
+tests use the emulated engine backend, which needs no NIC.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+# Hard-set (not setdefault): the ambient environment may point JAX at a
+# real TPU, but the test suite is defined to be hardware-free.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_trace():
+    from rocnrdma_tpu.utils.trace import trace
+
+    trace.reset()
+    yield

@@ -1,0 +1,269 @@
+"""Pin-lifecycle tests — the amdp2ptest suite, hardware-free.
+
+Mirrors what the reference's kernel test module exercised on real
+hardware via ioctls + dmesg (SURVEY.md §4): address classification,
+pin/unpin, page-size query, repeat-pin on one range, revocation on
+free-while-pinned, and cleanup-on-close — with asserts instead of a
+human reading printk.
+"""
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.hbm.registry import (
+    FakeHBMExporter,
+    HbmError,
+    PeerClient,
+    RegistrationManager,
+)
+from rocnrdma_tpu.transport import engine as eng
+from rocnrdma_tpu.utils.trace import trace
+
+from test_transport import free_port
+
+
+@pytest.fixture()
+def exporter():
+    return FakeHBMExporter()
+
+
+def test_is_device_address(exporter):
+    """ioctl_is_gpu_address equivalent (tests/amdp2ptest.c:141-165)."""
+    va = exporter.alloc(8192)
+    assert exporter.is_device_address(va)
+    assert exporter.is_device_address(va + 8191)
+    assert not exporter.is_device_address(va + 8192)
+    assert not exporter.is_device_address(0x1234)
+    # range check: must fit inside the allocation
+    assert exporter.is_device_address(va, 8192)
+    assert not exporter.is_device_address(va + 1, 8192)
+    exporter.free(va)
+
+
+def test_get_put_pages(exporter):
+    """ioctl_get_pages / ioctl_put_pages (tests/amdp2ptest.c:207-304)."""
+    va = exporter.alloc(3 * 4096)
+    pinned = exporter.get_pages(va + 100, 5000)
+    assert pinned.size == 5000
+    # sg entries cover the range exactly, split at page boundaries
+    assert sum(l for (_, l) in pinned.pages) == 5000
+    assert pinned.pages[0][0] == va + 100
+    assert exporter.live_pins() == 1
+    exporter.put_pages(pinned)
+    assert exporter.live_pins() == 0
+    exporter.free(va)
+
+
+def test_get_page_size(exporter):
+    """ioctl_get_page_size (tests/amdp2ptest.c:168-205) incl. the 4096
+    fallback behavior (amdp2p.c:339)."""
+    va = exporter.alloc(4096)
+    assert exporter.get_page_size(va) == 4096
+
+    class BrokenExporter(FakeHBMExporter):
+        def get_page_size(self, va):
+            raise RuntimeError("query failed")
+
+    broken = BrokenExporter()
+    bva = broken.alloc(4096)
+    client = PeerClient(broken)
+    ctx = client.acquire(bva, 4096)
+    assert client.get_page_size(ctx) == 4096
+    exporter.free(va)
+
+
+def test_double_pin_same_range(exporter):
+    """The reference deliberately supports get_pages twice on the same
+    range (tests/amdp2ptest.c:296-299)."""
+    va = exporter.alloc(4096)
+    p1 = exporter.get_pages(va, 4096)
+    p2 = exporter.get_pages(va, 4096)
+    assert exporter.live_pins() == 2
+    exporter.put_pages(p1)
+    exporter.put_pages(p2)
+    assert exporter.live_pins() == 0
+    exporter.free(va)
+
+
+def test_peer_client_state_machine(exporter):
+    """acquire → get_pages → dma_map → put_pages → release
+    (SURVEY.md §3.2/§3.5 call stacks)."""
+    va = exporter.alloc(8192)
+    client = PeerClient(exporter)
+    # acquire refuses non-device addresses (amd_acquire returns 0)
+    assert client.acquire(0xdeadbeef, 64) is None
+    ctx = client.acquire(va, 8192)
+    assert ctx is not None
+    # get_pages validates against acquire-time addr/size
+    # (amdp2p.c:188-198)
+    with pytest.raises(HbmError):
+        client.get_pages(ctx, va + 4096, 4096)
+    client.get_pages(ctx, va, 8192)
+    sg = client.dma_map(ctx)
+    assert sum(l for (_, l) in sg) == 8192
+    client.dma_unmap(ctx)
+    client.put_pages(ctx)
+    client.release(ctx)
+    assert exporter.live_pins() == 0
+    exporter.free(va)
+
+
+def test_revocation_free_while_pinned(exporter):
+    """§3.4: freeing pinned memory fires the free callback, which must
+    invalidate upward BEFORE pages are reclaimed, and a later
+    put_pages must be a no-op (amdp2p.c:88-109, 299-302)."""
+    events = []
+    client = PeerClient(exporter, invalidate_cb=lambda cc: events.append(cc))
+    va = exporter.alloc(4096)
+    ctx = client.acquire(va, 4096)
+    client.get_pages(ctx, va, 4096)
+    ctx.core_context = "ib-handle-cookie"
+
+    exporter.free(va)  # owner frees while registered
+
+    assert events == ["ib-handle-cookie"]
+    assert ctx.revoked
+    assert exporter.live_pins() == 0
+    # put_pages after revocation: must not double-free
+    client.put_pages(ctx)
+    client.release(ctx)
+
+
+def test_registration_manager_end_to_end(exporter):
+    """Full §3.2 stack against the transport: pin fake HBM, register
+    with the engine via the dma-buf path, RDMA-write into it remotely,
+    verify visibility, then deregister."""
+    e = eng.Engine("emu")
+    a, b = eng.loopback_pair(e, free_port())
+    mgr = RegistrationManager(e, exporter)
+
+    va = exporter.alloc(65536)
+    reg = mgr.register(va, 65536)
+    assert reg.page_size == 4096
+    assert mgr.live_count() == 1
+
+    src = np.arange(65536, dtype=np.uint8) % 199
+    with e.reg_mr(src) as smr:
+        a.post_write(smr, 0, reg.mr.addr, reg.mr.rkey, 65536, wr_id=1)
+        assert a.wait(1).ok
+
+    # Visibility through the CPU side of the fake HBM (the amdp2ptest
+    # mmap check, tests/amdp2ptest.c:336-395).
+    import ctypes
+
+    got = np.frombuffer(
+        (ctypes.c_char * 65536).from_address(va), dtype=np.uint8).copy()
+    np.testing.assert_array_equal(got, src)
+
+    mgr.deregister(reg)
+    assert mgr.live_count() == 0
+    assert exporter.live_pins() == 0
+    mgr.close()
+    a.close(); b.close(); e.close()
+
+
+def test_registration_manager_revocation_invalidates_mr(exporter):
+    """Free-while-registered propagates all the way to the NIC layer:
+    the MR is invalidated so remote access fails — the full §3.4 chain
+    KFD → free_callback → invalidate_peer_memory → MR teardown."""
+    e = eng.Engine("emu")
+    a, b = eng.loopback_pair(e, free_port())
+    mgr = RegistrationManager(e, exporter)
+
+    va = exporter.alloc(4096)
+    reg = mgr.register(va, 4096)
+    src = np.ones(4096, dtype=np.uint8)
+    with e.reg_mr(src) as smr:
+        a.post_write(smr, 0, reg.mr.addr, reg.mr.rkey, 4096, wr_id=1)
+        assert a.wait(1).ok
+
+        exporter.free(va)  # revoke
+
+        a.post_write(smr, 0, reg.mr.addr, reg.mr.rkey, 4096, wr_id=2)
+        assert a.wait(2).status == eng.WC_REM_ACCESS_ERR
+
+    # Deregistration after revocation is safe in any order.
+    mgr.deregister(reg)
+    mgr.close()
+    a.close(); b.close(); e.close()
+
+
+def test_cleanup_on_close_reclaims_leaks(exporter):
+    """Leaked registrations are reclaimed on close — the per-fd cleanup
+    path for crashed tests (tests/amdp2ptest.c:115-139)."""
+    e = eng.Engine("emu")
+    mgr = RegistrationManager(e, exporter)
+    vas = [exporter.alloc(4096) for _ in range(3)]
+    for va in vas:
+        mgr.register(va, 4096)
+    assert mgr.live_count() == 3
+    mgr.close()  # consumer "crashed" without deregistering
+    assert mgr.live_count() == 0
+    assert exporter.live_pins() == 0
+    assert trace.counter("regmgr.close_reclaimed") == 1
+    e.close()
+
+
+def test_tpu_exporter_contract_on_cpu_arrays():
+    """The TPUExporter implements the same contract over jax.Arrays
+    (CPU platform here; identical code path on device)."""
+    import jax.numpy as jnp
+
+    from rocnrdma_tpu.hbm.tpu import TPUExporter
+
+    exporter = TPUExporter()
+    arr = jnp.arange(1024, dtype=jnp.float32)
+    va = exporter.adopt(arr)
+    assert exporter.is_device_address(va, arr.nbytes)
+    assert not exporter.is_device_address(va + arr.nbytes)
+
+    events = []
+    client = PeerClient(exporter, invalidate_cb=events.append)
+    ctx = client.acquire(va, arr.nbytes)
+    client.get_pages(ctx, va, arr.nbytes)
+    ctx.core_context = "cookie"
+    assert exporter.live_pins() == 1
+
+    # dma-buf export is gated until libtpu grows the API
+    with pytest.raises(HbmError):
+        exporter.export_dmabuf(ctx.pinned)
+
+    # Releasing the adoption while pinned = free-while-registered.
+    exporter.release(va)
+    assert events == ["cookie"]
+    assert ctx.revoked and exporter.live_pins() == 0
+    client.put_pages(ctx)  # safe no-op
+
+
+def test_register_falls_back_when_dmabuf_reg_fails(exporter):
+    """If the engine rejects the dma-buf fd (TransportError, not
+    HbmError), register() must fall back to the legacy direct
+    registration instead of failing."""
+    e = eng.Engine("emu")
+    mgr = RegistrationManager(e, exporter)
+    orig = e.reg_dmabuf_mr
+    e.reg_dmabuf_mr = lambda *a, **k: (_ for _ in ()).throw(
+        eng.TransportError("engine rejects fd"))
+    va = exporter.alloc(4096)
+    reg = mgr.register(va, 4096)  # must not raise
+    assert reg.mr.length == 4096
+    mgr.deregister(reg)
+    e.reg_dmabuf_mr = orig
+    assert exporter.live_pins() == 0
+    mgr.close(); e.close()
+
+
+def test_register_failure_unwinds_pin(exporter):
+    """A registration that fails entirely must not leak the pin."""
+    e = eng.Engine("emu")
+    mgr = RegistrationManager(e, exporter)
+    e.reg_dmabuf_mr = lambda *a, **k: (_ for _ in ()).throw(
+        eng.TransportError("boom"))
+    e.reg_mr = lambda *a, **k: (_ for _ in ()).throw(
+        eng.TransportError("boom2"))
+    va = exporter.alloc(4096)
+    with pytest.raises(eng.TransportError):
+        mgr.register(va, 4096)
+    assert exporter.live_pins() == 0
+    assert mgr.live_count() == 0
+    e.close()
