@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line.
+
+Headline metric (BASELINE.md): cross-slice allreduce bus bandwidth —
+a 2-rank ring allreduce of 1 GiB float32 over the transport engine
+(the measurement BASELINE.json configs 0/3 define, on the emulated
+backend in this environment; the identical code path runs over verbs
+on HCA-equipped hosts). ``vs_baseline`` is the fraction of the
+north-star target, 90% of 100 Gb/s NIC line rate (11.25 GB/s bus
+bandwidth), since the reference publishes no numbers of its own
+(BASELINE.md "Reference-published numbers: none").
+
+Details carried alongside: ib_write_bw-style point-to-point loopback
+(config 0), and — when a real TPU is reachable — the device↔host
+staging bandwidth of the chip (the path whose elimination is the
+whole point) plus a model-forward sanity timing.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+# Bus-bandwidth target: 90% of 12.5 GB/s (100 Gb/s line rate).
+TARGET_BUS_GBPS = 0.9 * 12.5
+
+
+def bench_p2p_write(size=1 << 30, iters=3):
+    """ib_write_bw analogue: one-sided writes, loopback (config 0)."""
+    from rocnrdma_tpu.transport.engine import Engine, loopback_pair
+
+    import socket
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+
+    e = Engine("emu")
+    a, b = loopback_pair(e, port)
+    src = np.ones(size, dtype=np.uint8)
+    dst = np.zeros(size, dtype=np.uint8)
+    smr = e.reg_mr(src)
+    dmr = e.reg_mr(dst)
+    # warmup
+    a.post_write(smr, 0, dmr.addr, dmr.rkey, size, wr_id=0)
+    assert a.wait(0, timeout_ms=120000).ok
+    t0 = time.perf_counter()
+    for i in range(iters):
+        a.post_write(smr, 0, dmr.addr, dmr.rkey, size, wr_id=i + 1)
+        assert a.wait(i + 1, timeout_ms=120000).ok
+    dt = time.perf_counter() - t0
+    for m in (smr, dmr):
+        m.deregister()
+    a.close(); b.close(); e.close()
+    return size * iters / dt / 1e9
+
+
+def bench_allreduce(count=(1 << 30) // 4, world=2, iters=3):
+    """2-rank 1 GiB f32 ring allreduce bus bandwidth (config 3 shape)."""
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    import socket
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+
+    worlds = local_worlds(world, port + 1000)
+    bufs = [np.ones(count, dtype=np.float32) for _ in range(world)]
+
+    def run_all():
+        ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    run_all()  # warmup (also registers MRs once — steady state after)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_all()
+    dt = (time.perf_counter() - t0) / iters
+    for w in worlds:
+        w.close()
+    nbytes = count * 4
+    # Standard bus-bandwidth convention: 2*(world-1)/world of the
+    # buffer crosses each rank's link per allreduce.
+    return nbytes * 2 * (world - 1) / world / dt / 1e9
+
+
+_TPU_SNIPPET = r"""
+import json, time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+out = {}
+devs = [d for d in jax.devices() if d.platform != "cpu"]
+if devs:
+    n = 256 * (1 << 20) // 4
+    host = np.ones(n, dtype=np.float32)
+    t0 = time.perf_counter()
+    dev = jax.device_put(host, devs[0]); dev.block_until_ready()
+    out["tpu_h2d_GBps"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
+    t0 = time.perf_counter()
+    _ = np.asarray(dev)
+    out["tpu_d2h_GBps"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
+
+    sys.path.insert(0, %r)
+    from rocnrdma_tpu.models.llama import make_model, init_params
+    model = make_model("llama3-1b")
+    params = init_params(model, jax.random.PRNGKey(0))
+    tokens = jnp.ones((1, 2048), dtype=jnp.int32)
+    fwd = jax.jit(lambda p, t: model.apply(p, t))
+    fwd(params, tokens).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(3):
+        fwd(params, tokens).block_until_ready()
+    out["llama3_1b_fwd_tokens_per_s"] = round(2048 / ((time.perf_counter() - t0) / 3), 1)
+print("TPUBENCH " + json.dumps(out))
+"""
+
+
+def bench_tpu_details(timeout_s=600):
+    """TPU-side sub-benches (staging bandwidth + model forward), run in
+    a subprocess so an unreachable device tunnel times out instead of
+    hanging the whole bench."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             _TPU_SNIPPET % os.path.dirname(os.path.abspath(__file__))],
+            capture_output=True, text=True, timeout=timeout_s)
+        for line in proc.stdout.splitlines():
+            if line.startswith("TPUBENCH "):
+                return json.loads(line[len("TPUBENCH "):])
+    except Exception:
+        pass
+    return {}
+
+
+def main():
+    details = {}
+    details["p2p_write_GBps"] = round(bench_p2p_write(), 3)
+    bus = bench_allreduce()
+    details["allreduce_world"] = 2
+    details["allreduce_bytes"] = 1 << 30
+    details.update(bench_tpu_details())
+    print(json.dumps({
+        "metric": "cross_slice_allreduce_bus_bw",
+        "value": round(bus, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(bus / TARGET_BUS_GBPS, 3),
+        "details": details,
+    }))
+
+
+if __name__ == "__main__":
+    main()

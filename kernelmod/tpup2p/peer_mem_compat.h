@@ -1,0 +1,50 @@
+/* Local declaration of the Mellanox OFED peer-memory client ABI.
+ *
+ * The real header (rdma/peer_mem.h) ships only with MLNX_OFED; the
+ * reference repo had the same problem and solved it by requiring OFED
+ * at build time (Makefile:17-18 links Module.symvers). We declare the
+ * contract locally instead so the bridge at least compiles against
+ * plain kernel headers for CI-style syntax checking; linking still
+ * requires the OFED tree (see Makefile).
+ *
+ * ABI shape per the upstream peer-memory patches: a client registers a
+ * named ops table; ib_core polls acquire() across clients at
+ * ibv_reg_mr time, then drives get_pages/dma_map, and hands back an
+ * invalidation callback for asynchronous revocation.
+ */
+#ifndef TPUP2P_PEER_MEM_COMPAT_H
+#define TPUP2P_PEER_MEM_COMPAT_H
+
+#include <linux/scatterlist.h>
+#include <linux/types.h>
+
+#define IB_PEER_MEMORY_NAME_MAX 64
+#define IB_PEER_MEMORY_VER_MAX 16
+
+struct peer_memory_client {
+	char name[IB_PEER_MEMORY_NAME_MAX];
+	char version[IB_PEER_MEMORY_VER_MAX];
+	int (*acquire)(unsigned long addr, size_t size,
+		       void *peer_mem_private_data,
+		       char *peer_mem_name, void **client_context);
+	int (*get_pages)(unsigned long addr, size_t size, int write,
+			 int force, struct sg_table *sg_head,
+			 void *client_context, u64 core_context);
+	int (*dma_map)(struct sg_table *sg_head, void *client_context,
+		       struct device *dma_device, int dmasync, int *nmap);
+	int (*dma_unmap)(struct sg_table *sg_head, void *client_context,
+			 struct device *dma_device);
+	void (*put_pages)(struct sg_table *sg_head, void *client_context);
+	unsigned long (*get_page_size)(void *client_context);
+	void (*release)(void *client_context);
+	void *(*get_context_private_data)(u64 peer_id);
+	void (*put_context_private_data)(void *context);
+};
+
+typedef int (*invalidate_peer_memory)(void *reg_handle, u64 core_context);
+
+void *ib_register_peer_memory_client(const struct peer_memory_client *client,
+				     invalidate_peer_memory *invalidate_cb);
+void ib_unregister_peer_memory_client(void *reg_handle);
+
+#endif /* TPUP2P_PEER_MEM_COMPAT_H */

@@ -1,0 +1,452 @@
+// SPDX-License-Identifier: MIT
+/*
+ * tpup2p — peer-memory bridge from legacy OFED PeerDirect stacks to
+ * dma-buf-exported TPU HBM.
+ *
+ * Functional mirror of the role AMD's amdp2p bridge played for KFD
+ * memory (reference: rocmarchive/ROCnRDMA, amdp2p.c), re-based on the
+ * kernel's dma-buf machinery:
+ *
+ *   amdp2p (reference)                  tpup2p (this module)
+ *   ------------------------------     --------------------------------
+ *   is_gpu_address() query to KFD      VA-range table fed by userspace
+ *     (amdp2p.c:127)                     ioctl (tpup2p_claim/unclaim)
+ *   get_pages() pins via KFD           dma_buf_get + attach; pages stay
+ *     (amdp2p.c:200-205)                 exporter-owned
+ *   dma_map() copies prebuilt sg       dma_buf_map_attachment builds a
+ *     list, no IOMMU work               properly IOMMU-mapped sg table
+ *     (amdp2p.c:222-240, 258)           (the fix for that caveat)
+ *   free_callback → invalidate         move_notify → invalidate
+ *     (amdp2p.c:88-109)                  (dynamic attachment)
+ *   free_callback_called flag          ctx->revoked under ctx->lock
+ *     (amdp2p.c:299-302)
+ *
+ * Userspace flow: the runtime (rocnrdma_tpu.hbm) obtains a dma-buf fd
+ * for a HBM region from the TPU driver, then tells this bridge which
+ * VA range the fd backs via TPUP2P_IOC_CLAIM on /dev/tpup2p. A later
+ * ibv_reg_mr() over that VA range is claimed by acquire(), pinned via
+ * the dma-buf attach path, and revoked through ib_core's invalidate
+ * callback if the exporter moves/frees the buffer while registered.
+ */
+
+#include <linux/cdev.h>
+#include <linux/dma-buf.h>
+#include <linux/dma-resv.h>
+#include <linux/fs.h>
+#include <linux/miscdevice.h>
+#include <linux/module.h>
+#include <linux/mutex.h>
+#include <linux/rbtree.h>
+#include <linux/sched.h>
+#include <linux/slab.h>
+#include <linux/uaccess.h>
+
+#include "peer_mem_compat.h"
+#include "tpup2p_uapi.h"
+
+#define TPUP2P_NAME "tpup2p"
+#define TPUP2P_VERSION "1.0"
+
+#define t2p_dbg(fmt, ...) pr_debug(TPUP2P_NAME ": " fmt, ##__VA_ARGS__)
+#define t2p_err(fmt, ...) pr_err(TPUP2P_NAME ": " fmt, ##__VA_ARGS__)
+
+/* ------------------------------------------------------------------ *
+ * VA-range claim table (role of KFD's is_gpu_address): which VA
+ * ranges of which process are backed by which dma-buf fd.
+ * ------------------------------------------------------------------ */
+
+struct t2p_claim {
+	struct rb_node node;
+	u64 va;
+	u64 len;
+	pid_t tgid;
+	/* dma-buf reference held from claim to unclaim */
+	struct dma_buf *dbuf;
+	u64 dbuf_offset;
+};
+
+static struct rb_root t2p_claims = RB_ROOT;
+static DEFINE_MUTEX(t2p_claims_lock);
+
+static struct t2p_claim *t2p_claim_find(u64 va, u64 len, pid_t tgid)
+{
+	struct rb_node *n = t2p_claims.rb_node;
+
+	while (n) {
+		struct t2p_claim *c = rb_entry(n, struct t2p_claim, node);
+
+		if (va < c->va)
+			n = n->rb_left;
+		else if (va >= c->va + c->len)
+			n = n->rb_right;
+		else
+			return (c->tgid == tgid &&
+				va + len <= c->va + c->len) ? c : NULL;
+	}
+	return NULL;
+}
+
+static int t2p_claim_insert(struct t2p_claim *nc)
+{
+	struct rb_node **p = &t2p_claims.rb_node, *parent = NULL;
+
+	while (*p) {
+		struct t2p_claim *c = rb_entry(*p, struct t2p_claim, node);
+
+		parent = *p;
+		if (nc->va + nc->len <= c->va)
+			p = &(*p)->rb_left;
+		else if (nc->va >= c->va + c->len)
+			p = &(*p)->rb_right;
+		else
+			return -EEXIST;	/* overlapping claim */
+	}
+	rb_link_node(&nc->node, parent, p);
+	rb_insert_color(&nc->node, &t2p_claims);
+	return 0;
+}
+
+/* ------------------------------------------------------------------ *
+ * Per-registration context (role of struct amd_mem_context)
+ * ------------------------------------------------------------------ */
+
+struct t2p_ctx {
+	u64 va;
+	u64 len;
+	pid_t tgid;
+	struct dma_buf *dbuf;
+	u64 dbuf_offset;
+	struct dma_buf_attachment *att;
+	struct sg_table *sgt;
+	u64 core_context;	/* ib_core cookie for invalidation */
+	struct mutex lock;
+	bool revoked;		/* exporter moved/freed while registered */
+	bool mapped;
+};
+
+static void *t2p_invalidate_handle;
+static invalidate_peer_memory t2p_invalidate_cb;
+
+/* Claim-table lookup for sibling modules (tpup2ptest). Returns the
+ * dma-buf backing [va, va+len) for the calling process, or NULL; the
+ * caller takes no reference — it must get_dma_buf() if it keeps it. */
+struct dma_buf *tpup2p_resolve_claim(u64 va, u64 len, u64 *offset)
+{
+	struct t2p_claim *c;
+	struct dma_buf *dbuf = NULL;
+
+	mutex_lock(&t2p_claims_lock);
+	c = t2p_claim_find(va, len, task_tgid_nr(current));
+	if (c) {
+		dbuf = c->dbuf;
+		*offset = c->dbuf_offset + (va - c->va);
+	}
+	mutex_unlock(&t2p_claims_lock);
+	return dbuf;
+}
+EXPORT_SYMBOL_GPL(tpup2p_resolve_claim);
+
+/* Exporter-initiated revocation: dynamic dma-buf attachments get a
+ * move_notify when the backing storage is about to move or vanish —
+ * the same moment KFD fired the reference's free_callback. Invalidate
+ * upward first, then flag the context so put_pages after the fact is
+ * a no-op. */
+static void t2p_move_notify(struct dma_buf_attachment *att)
+{
+	struct t2p_ctx *ctx = att->importer_priv;
+
+	t2p_dbg("move_notify va=%llx len=%llu\n", ctx->va, ctx->len);
+	if (t2p_invalidate_cb && ctx->core_context)
+		t2p_invalidate_cb(t2p_invalidate_handle, ctx->core_context);
+	mutex_lock(&ctx->lock);
+	/* Dynamic-importer contract: tear down our mapping before the
+	 * exporter moves the storage (the caller holds the resv lock,
+	 * so the locked unmap variant is correct here). */
+	if (ctx->mapped && ctx->sgt) {
+		dma_buf_unmap_attachment(ctx->att, ctx->sgt,
+					 DMA_BIDIRECTIONAL);
+		ctx->sgt = NULL;
+		ctx->mapped = false;
+	}
+	ctx->revoked = true;
+	mutex_unlock(&ctx->lock);
+}
+
+static const struct dma_buf_attach_ops t2p_attach_ops = {
+	.allow_peer2peer = true,
+	.move_notify = t2p_move_notify,
+};
+
+/* ------------------------------------------------------------------ *
+ * peer_memory_client ops
+ * ------------------------------------------------------------------ */
+
+static int t2p_acquire(unsigned long addr, size_t size,
+		       void *peer_mem_private_data, char *peer_mem_name,
+		       void **client_context)
+{
+	struct t2p_claim *claim;
+	struct t2p_ctx *ctx;
+	pid_t tgid = task_tgid_nr(current);
+
+	mutex_lock(&t2p_claims_lock);
+	claim = t2p_claim_find(addr, size, tgid);
+	if (!claim) {
+		mutex_unlock(&t2p_claims_lock);
+		return 0;	/* not ours */
+	}
+
+	ctx = kzalloc(sizeof(*ctx), GFP_KERNEL);
+	if (!ctx) {
+		mutex_unlock(&t2p_claims_lock);
+		return 0;	/* claim refused on alloc failure */
+	}
+	ctx->va = addr;
+	ctx->len = size;
+	ctx->tgid = tgid;
+	get_dma_buf(claim->dbuf);
+	ctx->dbuf = claim->dbuf;
+	ctx->dbuf_offset = claim->dbuf_offset + (addr - claim->va);
+	mutex_init(&ctx->lock);
+	mutex_unlock(&t2p_claims_lock);
+
+	__module_get(THIS_MODULE);
+	*client_context = ctx;
+	t2p_dbg("acquire va=%lx len=%zu tgid=%d\n", addr, size, tgid);
+	return 1;
+}
+
+static int t2p_get_pages(unsigned long addr, size_t size, int write,
+			 int force, struct sg_table *sg_head,
+			 void *client_context, u64 core_context)
+{
+	struct t2p_ctx *ctx = client_context;
+
+	if (addr != ctx->va || size != ctx->len)
+		return -EINVAL;
+
+	/* The attachment needs the DMA device, which the peer-memory
+	 * contract only supplies at dma_map time — so only the ib_core
+	 * cookie is recorded here. (dma_buf_dynamic_attach rejects a
+	 * NULL device.) */
+	ctx->core_context = core_context;
+	return 0;
+}
+
+static int t2p_dma_map(struct sg_table *sg_head, void *client_context,
+		       struct device *dma_device, int dmasync, int *nmap)
+{
+	struct t2p_ctx *ctx = client_context;
+	struct sg_table *sgt;
+
+	ctx->att = dma_buf_dynamic_attach(ctx->dbuf, dma_device,
+					  &t2p_attach_ops, ctx);
+	if (IS_ERR(ctx->att)) {
+		int ret = PTR_ERR(ctx->att);
+
+		ctx->att = NULL;
+		t2p_err("dynamic attach failed: %d\n", ret);
+		return ret;
+	}
+
+	dma_resv_lock(ctx->dbuf->resv, NULL);
+	sgt = dma_buf_map_attachment(ctx->att, DMA_BIDIRECTIONAL);
+	dma_resv_unlock(ctx->dbuf->resv);
+	if (IS_ERR(sgt)) {
+		dma_buf_detach(ctx->dbuf, ctx->att);
+		ctx->att = NULL;
+		return PTR_ERR(sgt);
+	}
+
+	ctx->sgt = sgt;
+	ctx->mapped = true;
+	*sg_head = *sgt;
+	*nmap = sgt->nents;
+	t2p_dbg("dma_map va=%llx nents=%d\n", ctx->va, sgt->nents);
+	return 0;
+}
+
+static int t2p_dma_unmap(struct sg_table *sg_head, void *client_context,
+			 struct device *dma_device)
+{
+	struct t2p_ctx *ctx = client_context;
+
+	mutex_lock(&ctx->lock);
+	if (ctx->mapped && ctx->att && ctx->sgt) {
+		dma_resv_lock(ctx->dbuf->resv, NULL);
+		dma_buf_unmap_attachment(ctx->att, ctx->sgt,
+					 DMA_BIDIRECTIONAL);
+		dma_resv_unlock(ctx->dbuf->resv);
+		ctx->sgt = NULL;
+		ctx->mapped = false;
+	}
+	mutex_unlock(&ctx->lock);
+	return 0;
+}
+
+static void t2p_put_pages(struct sg_table *sg_head, void *client_context)
+{
+	struct t2p_ctx *ctx = client_context;
+
+	mutex_lock(&ctx->lock);
+	/* The MAPPING must not be unmapped twice after revocation
+	 * (move_notify already tore it down — the double-free the
+	 * reference guards with free_callback_called, amdp2p.c:299-302)
+	 * — but the ATTACHMENT is ours in every path: leaving it on the
+	 * dma-buf's attachment list with importer_priv pointing at a
+	 * soon-freed ctx would make the exporter's next walk a
+	 * use-after-free. */
+	if (ctx->mapped && ctx->sgt && !ctx->revoked) {
+		dma_resv_lock(ctx->dbuf->resv, NULL);
+		dma_buf_unmap_attachment(ctx->att, ctx->sgt,
+					 DMA_BIDIRECTIONAL);
+		dma_resv_unlock(ctx->dbuf->resv);
+		ctx->sgt = NULL;
+		ctx->mapped = false;
+	}
+	if (ctx->att) {
+		dma_buf_detach(ctx->dbuf, ctx->att);
+		ctx->att = NULL;
+	}
+	mutex_unlock(&ctx->lock);
+}
+
+static unsigned long t2p_get_page_size(void *client_context)
+{
+	/* dma-buf exporters are page-granular; PAGE_SIZE matches the
+	 * reference's fallback (amdp2p.c:339). */
+	return PAGE_SIZE;
+}
+
+static void t2p_release(void *client_context)
+{
+	struct t2p_ctx *ctx = client_context;
+
+	dma_buf_put(ctx->dbuf);
+	kfree(ctx);
+	module_put(THIS_MODULE);
+}
+
+static const struct peer_memory_client t2p_client = {
+	.name = TPUP2P_NAME,
+	.version = TPUP2P_VERSION,
+	.acquire = t2p_acquire,
+	.get_pages = t2p_get_pages,
+	.dma_map = t2p_dma_map,
+	.dma_unmap = t2p_dma_unmap,
+	.put_pages = t2p_put_pages,
+	.get_page_size = t2p_get_page_size,
+	.release = t2p_release,
+};
+
+/* ------------------------------------------------------------------ *
+ * /dev/tpup2p — claim-management ioctls from the userspace runtime
+ * ------------------------------------------------------------------ */
+
+static long t2p_ioctl_claim(unsigned long arg)
+{
+	struct tpup2p_claim_param p;
+	struct t2p_claim *c;
+	int ret;
+
+	if (copy_from_user(&p, (void __user *)arg, sizeof(p)))
+		return -EFAULT;
+
+	c = kzalloc(sizeof(*c), GFP_KERNEL);
+	if (!c)
+		return -ENOMEM;
+	c->va = p.va;
+	c->len = p.len;
+	c->tgid = task_tgid_nr(current);
+	c->dbuf_offset = p.dmabuf_offset;
+	c->dbuf = dma_buf_get(p.dmabuf_fd);
+	if (IS_ERR(c->dbuf)) {
+		ret = PTR_ERR(c->dbuf);
+		kfree(c);
+		return ret;
+	}
+
+	mutex_lock(&t2p_claims_lock);
+	ret = t2p_claim_insert(c);
+	mutex_unlock(&t2p_claims_lock);
+	if (ret) {
+		dma_buf_put(c->dbuf);
+		kfree(c);
+	}
+	return ret;
+}
+
+static long t2p_ioctl_unclaim(unsigned long arg)
+{
+	struct tpup2p_unclaim_param p;
+	struct t2p_claim *c;
+
+	if (copy_from_user(&p, (void __user *)arg, sizeof(p)))
+		return -EFAULT;
+
+	mutex_lock(&t2p_claims_lock);
+	c = t2p_claim_find(p.va, 1, task_tgid_nr(current));
+	if (c)
+		rb_erase(&c->node, &t2p_claims);
+	mutex_unlock(&t2p_claims_lock);
+	if (!c)
+		return -ENOENT;
+	dma_buf_put(c->dbuf);
+	kfree(c);
+	return 0;
+}
+
+static long t2p_ioctl(struct file *filp, unsigned int cmd, unsigned long arg)
+{
+	switch (cmd) {
+	case TPUP2P_IOC_CLAIM:
+		return t2p_ioctl_claim(arg);
+	case TPUP2P_IOC_UNCLAIM:
+		return t2p_ioctl_unclaim(arg);
+	default:
+		return -ENOTTY;
+	}
+}
+
+static const struct file_operations t2p_fops = {
+	.owner = THIS_MODULE,
+	.unlocked_ioctl = t2p_ioctl,
+};
+
+static struct miscdevice t2p_misc = {
+	.minor = MISC_DYNAMIC_MINOR,
+	.name = TPUP2P_NAME,
+	.fops = &t2p_fops,
+	.mode = 0660,
+};
+
+static int __init tpup2p_init(void)
+{
+	int ret;
+
+	ret = misc_register(&t2p_misc);
+	if (ret)
+		return ret;
+
+	t2p_invalidate_handle = ib_register_peer_memory_client(
+		&t2p_client, &t2p_invalidate_cb);
+	if (!t2p_invalidate_handle) {
+		misc_deregister(&t2p_misc);
+		t2p_err("peer-memory registration failed\n");
+		return -ENODEV;
+	}
+	pr_info(TPUP2P_NAME ": registered (dma-buf peer-memory bridge)\n");
+	return 0;
+}
+
+static void __exit tpup2p_exit(void)
+{
+	ib_unregister_peer_memory_client(t2p_invalidate_handle);
+	misc_deregister(&t2p_misc);
+}
+
+module_init(tpup2p_init);
+module_exit(tpup2p_exit);
+
+MODULE_LICENSE("Dual MIT/GPL");
+MODULE_DESCRIPTION("TPU HBM peer-memory bridge over dma-buf");

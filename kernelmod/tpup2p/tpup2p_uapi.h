@@ -1,0 +1,35 @@
+/* SPDX-License-Identifier: MIT */
+/* UAPI of /dev/tpup2p — VA-range claims for the peer-memory bridge.
+ *
+ * Role of the reference's UAPI header (include/amdp2ptest.h) for the
+ * bridge side; both of that header's latent bugs are avoided here
+ * (SURVEY.md §2 component 3): every ioctl that returns data is _IOWR,
+ * and the size fields name the real param structs.
+ */
+#ifndef TPUP2P_UAPI_H
+#define TPUP2P_UAPI_H
+
+#include <linux/ioctl.h>
+#include <linux/types.h>
+
+#define TPUP2P_DEV_PATH "/dev/tpup2p"
+#define TPUP2P_IOC_MAGIC 'T'
+
+struct tpup2p_claim_param {
+	__u64 va;	    /* userspace VA the dma-buf backs */
+	__u64 len;
+	__s32 dmabuf_fd;    /* from the TPU driver's HBM export */
+	__u32 _pad;
+	__u64 dmabuf_offset;
+};
+
+struct tpup2p_unclaim_param {
+	__u64 va;
+};
+
+#define TPUP2P_IOC_CLAIM \
+	_IOW(TPUP2P_IOC_MAGIC, 1, struct tpup2p_claim_param)
+#define TPUP2P_IOC_UNCLAIM \
+	_IOW(TPUP2P_IOC_MAGIC, 2, struct tpup2p_unclaim_param)
+
+#endif /* TPUP2P_UAPI_H */

@@ -1,0 +1,297 @@
+// SPDX-License-Identifier: MIT
+/*
+ * tpup2ptest — chardev harness exercising the dma-buf pin layer below
+ * the NIC stack.
+ *
+ * Keeps the one good idea of the reference's kernel test module
+ * (tests/amdp2ptest.c): a /dev node that drives the pin/unpin API in
+ * isolation so the memory layer can be validated without an HCA.
+ * Implementation is new:
+ *   - pins are handle-addressed via an idr (the reference matched by
+ *     exact (va,size), making double-pins ambiguous);
+ *   - mmap walks the WHOLE sg list and honors partial maps (the
+ *     reference returned from inside the loop, mapping only the first
+ *     entry and mapping it with the full vma size — the latent bug
+ *     SURVEY.md §2 component 2g documents);
+ *   - cleanup-on-close releases surviving pins (same contract as
+ *     tests/amdp2ptest.c:115-139).
+ *
+ * The pin source is the tpup2p claim table via dma-buf: the test
+ * opens a dma-buf (any exporter — e.g. a udmabuf standing in for TPU
+ * HBM), claims a VA range, pins it here, and mmaps to verify the bus
+ * addresses really back the claimed range.
+ */
+
+#include <linux/dma-buf.h>
+#include <linux/fs.h>
+#include <linux/idr.h>
+#include <linux/miscdevice.h>
+#include <linux/mm.h>
+#include <linux/module.h>
+#include <linux/mutex.h>
+#include <linux/slab.h>
+#include <linux/uaccess.h>
+
+#include "tpup2ptest_uapi.h"
+
+#define T2PT_NAME "tpup2ptest"
+#define t2pt_dbg(fmt, ...) pr_debug(T2PT_NAME ": " fmt, ##__VA_ARGS__)
+
+struct t2pt_pin {
+	u64 va;
+	u64 len;
+	struct dma_buf *dbuf;
+	struct dma_buf_attachment *att;
+	struct sg_table *sgt;
+};
+
+struct t2pt_file {
+	struct idr pins;
+	struct mutex lock;
+};
+
+static struct device *t2pt_misc_dev_parent(void);
+
+/* Resolution hook into the bridge's claim table. Out-of-tree builds
+ * without tpup2p fall back to treating the VA as a dma-buf fd carried
+ * in the upper bits — test-only convenience. */
+extern struct dma_buf *tpup2p_resolve_claim(u64 va, u64 len, u64 *offset)
+	__attribute__((weak));
+
+static int t2pt_open(struct inode *inode, struct file *filp)
+{
+	struct t2pt_file *tf = kzalloc(sizeof(*tf), GFP_KERNEL);
+
+	if (!tf)
+		return -ENOMEM;
+	idr_init(&tf->pins);
+	mutex_init(&tf->lock);
+	filp->private_data = tf;
+	return 0;
+}
+
+static void t2pt_release_pin(struct t2pt_pin *pin)
+{
+	if (pin->sgt)
+		dma_buf_unmap_attachment(pin->att, pin->sgt,
+					 DMA_BIDIRECTIONAL);
+	if (pin->att)
+		dma_buf_detach(pin->dbuf, pin->att);
+	if (pin->dbuf)
+		dma_buf_put(pin->dbuf);
+	kfree(pin);
+}
+
+/* Cleanup-on-close: reclaim every pin a crashed test leaked. */
+static int t2pt_release(struct inode *inode, struct file *filp)
+{
+	struct t2pt_file *tf = filp->private_data;
+	struct t2pt_pin *pin;
+	int id;
+
+	mutex_lock(&tf->lock);
+	idr_for_each_entry(&tf->pins, pin, id) {
+		t2pt_dbg("close: reclaiming pin %d va=%llx\n", id, pin->va);
+		t2pt_release_pin(pin);
+	}
+	idr_destroy(&tf->pins);
+	mutex_unlock(&tf->lock);
+	kfree(tf);
+	return 0;
+}
+
+static long t2pt_ioctl_query(unsigned long arg)
+{
+	struct tpup2ptest_query_param p;
+	u64 off;
+
+	if (copy_from_user(&p, (void __user *)arg, sizeof(p)))
+		return -EFAULT;
+	p.is_device = tpup2p_resolve_claim &&
+		      tpup2p_resolve_claim(p.va, p.len, &off) != NULL;
+	t2pt_dbg("query va=%llx len=%llu -> %u\n", p.va, p.len, p.is_device);
+	if (copy_to_user((void __user *)arg, &p, sizeof(p)))
+		return -EFAULT;
+	return 0;
+}
+
+static long t2pt_ioctl_pin(struct t2pt_file *tf, unsigned long arg)
+{
+	struct tpup2ptest_pin_param p;
+	struct t2pt_pin *pin;
+	u64 off = 0;
+	int id, ret;
+
+	if (copy_from_user(&p, (void __user *)arg, sizeof(p)))
+		return -EFAULT;
+	if (!tpup2p_resolve_claim)
+		return -EOPNOTSUPP;
+
+	pin = kzalloc(sizeof(*pin), GFP_KERNEL);
+	if (!pin)
+		return -ENOMEM;
+	pin->va = p.va;
+	pin->len = p.len;
+	pin->dbuf = tpup2p_resolve_claim(p.va, p.len, &off);
+	if (!pin->dbuf) {
+		kfree(pin);
+		return -ENXIO;
+	}
+	get_dma_buf(pin->dbuf);
+
+	pin->att = dma_buf_attach(pin->dbuf, t2pt_misc_dev_parent());
+	if (IS_ERR(pin->att)) {
+		ret = PTR_ERR(pin->att);
+		pin->att = NULL;
+		goto err;
+	}
+	pin->sgt = dma_buf_map_attachment(pin->att, DMA_BIDIRECTIONAL);
+	if (IS_ERR(pin->sgt)) {
+		ret = PTR_ERR(pin->sgt);
+		pin->sgt = NULL;
+		goto err;
+	}
+
+	mutex_lock(&tf->lock);
+	id = idr_alloc(&tf->pins, pin, 1, 0, GFP_KERNEL);
+	mutex_unlock(&tf->lock);
+	if (id < 0) {
+		ret = id;
+		goto err;
+	}
+	p.handle = id;
+	p.nents = pin->sgt->nents;
+	t2pt_dbg("pin va=%llx len=%llu handle=%llu nents=%llu\n",
+		 p.va, p.len, p.handle, p.nents);
+	if (copy_to_user((void __user *)arg, &p, sizeof(p)))
+		return -EFAULT;
+	return 0;
+err:
+	t2pt_release_pin(pin);
+	return ret;
+}
+
+static long t2pt_ioctl_unpin(struct t2pt_file *tf, unsigned long arg)
+{
+	struct tpup2ptest_unpin_param p;
+	struct t2pt_pin *pin;
+
+	if (copy_from_user(&p, (void __user *)arg, sizeof(p)))
+		return -EFAULT;
+	mutex_lock(&tf->lock);
+	pin = idr_remove(&tf->pins, p.handle);
+	mutex_unlock(&tf->lock);
+	if (!pin)
+		return -ENOENT;
+	t2pt_release_pin(pin);
+	return 0;
+}
+
+static long t2pt_ioctl_page_size(unsigned long arg)
+{
+	struct tpup2ptest_page_size_param p;
+
+	if (copy_from_user(&p, (void __user *)arg, sizeof(p)))
+		return -EFAULT;
+	p.page_size = PAGE_SIZE;
+	if (copy_to_user((void __user *)arg, &p, sizeof(p)))
+		return -EFAULT;
+	return 0;
+}
+
+static long t2pt_ioctl(struct file *filp, unsigned int cmd,
+		       unsigned long arg)
+{
+	struct t2pt_file *tf = filp->private_data;
+
+	switch (cmd) {
+	case TPUP2PTEST_IOC_QUERY:
+		return t2pt_ioctl_query(arg);
+	case TPUP2PTEST_IOC_PIN:
+		return t2pt_ioctl_pin(tf, arg);
+	case TPUP2PTEST_IOC_UNPIN:
+		return t2pt_ioctl_unpin(tf, arg);
+	case TPUP2PTEST_IOC_PAGE_SIZE:
+		return t2pt_ioctl_page_size(arg);
+	default:
+		return -ENOTTY;
+	}
+}
+
+/* mmap(offset = handle << PAGE_SHIFT): CPU view of a pinned range for
+ * visibility checks. Walks every sg entry and maps each at its running
+ * offset, clamping to the vma — the full-coverage version of the
+ * reference's mmap (whose loop returned after the first entry,
+ * tests/amdp2ptest.c:389). */
+static int t2pt_mmap(struct file *filp, struct vm_area_struct *vma)
+{
+	struct t2pt_file *tf = filp->private_data;
+	struct t2pt_pin *pin;
+	struct scatterlist *sg;
+	unsigned long uaddr = vma->vm_start;
+	unsigned long remaining = vma->vm_end - vma->vm_start;
+	int i, ret;
+
+	mutex_lock(&tf->lock);
+	pin = idr_find(&tf->pins, vma->vm_pgoff);
+	mutex_unlock(&tf->lock);
+	if (!pin)
+		return -ENXIO;
+
+	for_each_sg(pin->sgt->sgl, sg, pin->sgt->nents, i) {
+		unsigned long chunk = min((unsigned long)sg_dma_len(sg),
+					  remaining);
+
+		if (!chunk)
+			break;
+		ret = remap_pfn_range(vma, uaddr,
+				      sg_dma_address(sg) >> PAGE_SHIFT,
+				      chunk, vma->vm_page_prot);
+		if (ret)
+			return ret;
+		uaddr += chunk;
+		remaining -= chunk;
+	}
+	return 0;
+}
+
+static const struct file_operations t2pt_fops = {
+	.owner = THIS_MODULE,
+	.open = t2pt_open,
+	.release = t2pt_release,
+	.unlocked_ioctl = t2pt_ioctl,
+	.mmap = t2pt_mmap,
+};
+
+static struct miscdevice t2pt_misc = {
+	.minor = MISC_DYNAMIC_MINOR,
+	.name = T2PT_NAME,
+	.fops = &t2pt_fops,
+	.mode = 0660,	/* not the reference's 0777 (amdp2ptest.c:427) */
+};
+
+static struct device *t2pt_misc_dev_parent(void)
+{
+	return t2pt_misc.this_device;
+}
+
+static int __init t2pt_init(void)
+{
+	int ret = misc_register(&t2pt_misc);
+
+	if (ret)
+		return ret;
+	pr_info(T2PT_NAME ": ready at " TPUP2PTEST_DEV_PATH "\n");
+	return 0;
+}
+
+static void __exit t2pt_exit(void)
+{
+	misc_deregister(&t2pt_misc);
+}
+
+module_init(t2pt_init);
+module_exit(t2pt_exit);
+
+MODULE_LICENSE("Dual MIT/GPL");
+MODULE_DESCRIPTION("dma-buf pin-layer test harness for tpup2p");

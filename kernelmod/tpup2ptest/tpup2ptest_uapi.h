@@ -1,0 +1,57 @@
+/* SPDX-License-Identifier: MIT */
+/* UAPI of /dev/tpup2ptest — direct exercise of the dma-buf pin layer.
+ *
+ * The hardware-free mirror of the reference's kernel test harness UAPI
+ * (include/amdp2ptest.h: 4 ioctls + mmap). Differences by design:
+ * ioctls returning data are _IOWR (the reference's IS_GPU_ADDRESS was
+ * _IOW and named a nonexistent struct in its size field — SURVEY.md §2
+ * component 3), and the pin handle is explicit instead of keyed by
+ * (va,size) so double-pins are unambiguous.
+ */
+#ifndef TPUP2PTEST_UAPI_H
+#define TPUP2PTEST_UAPI_H
+
+#include <linux/ioctl.h>
+#include <linux/types.h>
+
+#define TPUP2PTEST_DEV_PATH "/dev/tpup2ptest"
+#define TPUP2PTEST_IOC_MAGIC 't'
+
+/* Is this VA range claimed as device memory? (role of
+ * AMDRDMA_IOCTL_IS_GPU_ADDRESS, tests/amdp2ptest.c:141-165) */
+struct tpup2ptest_query_param {
+	__u64 va;	/* in */
+	__u64 len;	/* in */
+	__u32 is_device;/* out */
+	__u32 _pad;
+};
+
+/* Pin a claimed range (role of AMDRDMA_IOCTL_GET_PAGES). */
+struct tpup2ptest_pin_param {
+	__u64 va;	/* in */
+	__u64 len;	/* in */
+	__u64 handle;	/* out: pin handle */
+	__u64 nents;	/* out: sg entries mapped */
+};
+
+/* Unpin by handle (role of AMDRDMA_IOCTL_PUT_PAGES). */
+struct tpup2ptest_unpin_param {
+	__u64 handle;	/* in */
+};
+
+/* Page size of the pinned range (role of AMDRDMA_IOCTL_GET_PAGE_SIZE). */
+struct tpup2ptest_page_size_param {
+	__u64 va;	 /* in */
+	__u64 page_size; /* out */
+};
+
+#define TPUP2PTEST_IOC_QUERY \
+	_IOWR(TPUP2PTEST_IOC_MAGIC, 1, struct tpup2ptest_query_param)
+#define TPUP2PTEST_IOC_PIN \
+	_IOWR(TPUP2PTEST_IOC_MAGIC, 2, struct tpup2ptest_pin_param)
+#define TPUP2PTEST_IOC_UNPIN \
+	_IOW(TPUP2PTEST_IOC_MAGIC, 3, struct tpup2ptest_unpin_param)
+#define TPUP2PTEST_IOC_PAGE_SIZE \
+	_IOWR(TPUP2PTEST_IOC_MAGIC, 4, struct tpup2ptest_page_size_param)
+
+#endif /* TPUP2PTEST_UAPI_H */

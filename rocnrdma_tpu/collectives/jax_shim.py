@@ -53,10 +53,19 @@ class CrossSliceAllReduce:
         self.world = world
         self.exporter = exporter
         self.mean = mean
+        # Persistent per-dtype staging buffers, registered with the
+        # ring ONCE (front-loaded registration): steady-state steps
+        # post work requests only, and the ring never sees a recycled
+        # allocator address.
+        self._staging: Dict[str, np.ndarray] = {}
 
-    def _allreduce_host(self, flat: np.ndarray) -> None:
-        staging.add(flat.nbytes * 2)  # D2H + H2D round trip
-        self.world.allreduce(flat, RED_SUM)
+    def _stage(self, dtype_str: str, count: int) -> np.ndarray:
+        buf = self._staging.get(dtype_str)
+        if buf is None or buf.size < count:
+            buf = np.empty(count, dtype=dtype_str)
+            self._staging[dtype_str] = buf
+            self.world.ring.register_buffer(buf)
+        return buf
 
     def __call__(self, tree):
         import jax
@@ -73,30 +82,40 @@ class CrossSliceAllReduce:
 
         out: List[Any] = list(leaves)
         for dtype_str, idxs in groups.items():
-            host_parts = []
-            for i in idxs:
-                # Zero-copy path would go here (export_dmabuf +
-                # reg_dmabuf_mr); with no exporter it is the staged get.
-                host_parts.append(np.asarray(jax.device_get(leaves[i])))
+            # Zero-copy path would go here (export_dmabuf +
+            # reg_dmabuf_mr on the device buffers); with no exporter
+            # this is the staged get into the pinned staging buffer.
+            host_parts = [np.asarray(jax.device_get(leaves[i]))
+                          for i in idxs]
             shapes = [p.shape for p in host_parts]
             sizes = [p.size for p in host_parts]
-            flat = np.concatenate([p.reshape(-1) for p in host_parts]) \
-                if len(host_parts) > 1 else host_parts[0].reshape(-1).copy()
-            flat = np.ascontiguousarray(flat)
-            self._allreduce_host(flat)
+            total = int(sum(sizes))
+            buf = self._stage(dtype_str, total)
+            offset = 0
+            for p in host_parts:
+                buf[offset:offset + p.size] = p.reshape(-1)
+                offset += p.size
+            flat = buf[:total]
+            staging.add(flat.nbytes * 2)  # D2H + H2D round trip
+            self.world.allreduce(flat, RED_SUM)
             if self.mean:
-                if flat.dtype == np.dtype("int32") or \
-                        flat.dtype == np.dtype("int64"):
-                    flat = flat // self.world.world
+                if flat.dtype.kind in "iu":
+                    flat //= self.world.world
                 else:
-                    flat = (flat.astype(np.float32) / self.world.world) \
-                        .astype(flat.dtype)
+                    # Divide in the array's own dtype — no silent
+                    # downcast of f64 (or upcast of bf16) gradients.
+                    flat /= np.asarray(self.world.world, dtype=flat.dtype)
             offset = 0
             for i, shape, size in zip(idxs, shapes, sizes):
-                piece = flat[offset:offset + size].reshape(shape)
+                piece = flat[offset:offset + size].reshape(shape).copy()
                 offset += size
-                out[i] = jax.device_put(jnp.asarray(piece)) \
-                    if not isinstance(leaves[i], np.ndarray) else piece
+                if isinstance(leaves[i], np.ndarray):
+                    out[i] = piece
+                else:
+                    # Restore the leaf onto its original sharding so a
+                    # dp×tp mesh doesn't funnel gradients through one
+                    # device.
+                    out[i] = jax.device_put(piece, leaves[i].sharding)
         trace.event("xslice.allreduce",
                     leaves=len(leaves), groups=len(groups))
         return jax.tree_util.tree_unflatten(treedef, out)

@@ -148,6 +148,11 @@ tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
                           int rank, int world);
 int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                        int red_op);
+/* Front-load registration for a caller-stable buffer; allreduces on it
+ * post work requests only. Unregistered buffers are registered per
+ * call (safe for arbitrary/recycled addresses, slower). */
+int tdr_ring_register(tdr_ring *r, void *base, size_t len);
+int tdr_ring_unregister(tdr_ring *r, void *base);
 void tdr_ring_destroy(tdr_ring *r);
 
 #ifdef __cplusplus

@@ -119,22 +119,25 @@ struct tdr_ring {
   int world;
   std::vector<char> tmp;
   tdr_mr *tmp_mr = nullptr;
-  // Registration cache: (base, len) -> MR. Front-loads reg cost.
-  std::unordered_map<uint64_t, tdr_mr *> mr_cache;
+  // MRs for buffers the CALLER promised stable (tdr_ring_register) —
+  // the front-loaded-registration fast path. Arbitrary buffers are
+  // registered per call instead: a VA-keyed implicit cache would hand
+  // out stale pins when an address gets recycled by the allocator
+  // (the underlying physical pages of a dead buffer, not the new one).
+  std::unordered_map<uint64_t, tdr_mr *> registered;
   std::mutex mu;
 
-  tdr_mr *data_mr(void *base, size_t len) {
+  // Returns the MR and whether it is borrowed (cached) or owned by
+  // this call (must be deregistered before returning).
+  tdr_mr *data_mr(void *base, size_t len, bool *owned) {
     uint64_t key = reinterpret_cast<uint64_t>(base);
-    auto it = mr_cache.find(key);
-    if (it != mr_cache.end() && tdr_mr_len(it->second) >= len)
+    auto it = registered.find(key);
+    if (it != registered.end() && tdr_mr_len(it->second) >= len) {
+      *owned = false;
       return it->second;
-    if (it != mr_cache.end()) {
-      tdr_dereg_mr(it->second);
-      mr_cache.erase(it);
     }
-    tdr_mr *mr = tdr_reg_mr(eng, base, len, 0);
-    if (mr) mr_cache[key] = mr;
-    return mr;
+    *owned = true;
+    return tdr_reg_mr(eng, base, len, 0);
   }
 
   tdr_mr *scratch(size_t len) {
@@ -169,9 +172,42 @@ tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
 
 void tdr_ring_destroy(tdr_ring *r) {
   if (!r) return;
-  for (auto &kv : r->mr_cache) tdr_dereg_mr(kv.second);
+  for (auto &kv : r->registered) tdr_dereg_mr(kv.second);
   if (r->tmp_mr) tdr_dereg_mr(r->tmp_mr);
   delete r;
+}
+
+// Pre-register a buffer whose lifetime the caller guarantees to
+// outlast the ring (or until tdr_ring_unregister). Steady-state
+// allreduces on it then post work requests only — the front-loaded
+// registration invariant of the reference (SURVEY.md §3.3).
+int tdr_ring_register(tdr_ring *r, void *base, size_t len) {
+  if (!r || !base || !len) {
+    tdr::set_error("ring_register: bad args");
+    return -1;
+  }
+  std::lock_guard<std::mutex> g(r->mu);
+  uint64_t key = reinterpret_cast<uint64_t>(base);
+  auto it = r->registered.find(key);
+  if (it != r->registered.end()) {
+    if (tdr_mr_len(it->second) >= len) return 0;
+    tdr_dereg_mr(it->second);
+    r->registered.erase(it);
+  }
+  tdr_mr *mr = tdr_reg_mr(r->eng, base, len, 0);
+  if (!mr) return -1;
+  r->registered[key] = mr;
+  return 0;
+}
+
+int tdr_ring_unregister(tdr_ring *r, void *base) {
+  if (!r) return -1;
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->registered.find(reinterpret_cast<uint64_t>(base));
+  if (it == r->registered.end()) return -1;
+  tdr_dereg_mr(it->second);
+  r->registered.erase(it);
+  return 0;
 }
 
 // Wait for one completion with the given wr_id on qp; other completions
@@ -228,9 +264,21 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
   for (int i = 0; i < world; i++)
     if (seg_len[i] > max_seg) max_seg = seg_len[i];
 
-  tdr_mr *dmr = r->data_mr(data, nbytes);
+  bool owned = false;
+  tdr_mr *dmr = r->data_mr(data, nbytes, &owned);
   tdr_mr *tmr = max_seg ? r->scratch(max_seg) : nullptr;
-  if (!dmr || (max_seg && !tmr)) return -1;
+  if (!dmr || (max_seg && !tmr)) {
+    if (owned && dmr) tdr_dereg_mr(dmr);
+    return -1;
+  }
+  struct OwnedGuard {
+    tdr_mr *mr;
+    bool active;
+    ~OwnedGuard() {
+      if (active && mr) tdr_dereg_mr(mr);
+    }
+  } guard{dmr, owned};
+  (void)guard;
 
   char *cdata = static_cast<char *>(data);
   const bool same_qp = (r->left == r->right);

@@ -1,0 +1,92 @@
+"""Fused RMSNorm — Pallas TPU kernel with an XLA reference path.
+
+The reference repo has no compute at all (it is a transport driver);
+this op belongs to the JAX consumer stack (BASELINE.md config 4's
+Llama training demo). The kernel keeps the row in VMEM, does the
+mean-square reduction and scale in one pass (f32 accumulation), and
+writes back in the input dtype — one HBM round trip instead of the
+several an unfused chain would cost.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK_ROWS = 256
+
+
+def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (y * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rmsnorm_fwd_pallas(x2d, w, eps: float, interpret: bool):
+    rows, d = x2d.shape
+    block = min(_BLOCK_ROWS, rows)
+    grid = (pl.cdiv(rows, block),)
+    return pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x2d.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2d, w.reshape(1, d))
+
+
+def rmsnorm_reference(x, w, eps: float = 1e-5):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps) * w.astype(jnp.float32)).astype(
+        x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def rmsnorm(x, w, eps: float = 1e-5, use_pallas: bool = True,
+            interpret: bool = False):
+    """RMSNorm over the last axis. ``use_pallas`` selects the fused
+    kernel for the forward pass; the backward pass is XLA (cheap and
+    fully fused by the compiler anyway)."""
+    if not use_pallas:
+        return rmsnorm_reference(x, w, eps)
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    out = _rmsnorm_fwd_pallas(x2d, w, eps, interpret)
+    return out.reshape(shape)
+
+
+def _rmsnorm_fwd(x, w, eps, use_pallas, interpret):
+    return rmsnorm(x, w, eps, use_pallas, interpret), (x, w)
+
+
+def _rmsnorm_bwd(eps, use_pallas, interpret, res, g):
+    x, w = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    xhat = xf * rstd
+    gw = gf * wf
+    d = x.shape[-1]
+    # d(x*rstd)/dx: rstd * (g*w − x̂ · mean(g*w · x̂)) — the second term
+    # is the projection from differentiating rsqrt(mean(x²)).
+    dx = rstd * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    dw = jnp.sum((gf * xhat).reshape(-1, d), axis=0)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)

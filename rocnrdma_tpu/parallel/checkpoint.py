@@ -1,0 +1,116 @@
+"""Checkpoint/resume for the trainer.
+
+The reference has no checkpointing (SURVEY.md §5: "absent — N/A for a
+transport driver"); the training consumer this framework adds needs
+it. Format: one ``.npz`` of path-flattened leaves (params + optimizer
+state) plus metadata — dependency-free and stable across optax's
+nested-tuple state structures. Restore is sharding-aware: leaves are
+``device_put`` back onto the trainer's mesh placements, so a dp×tp
+job resumes with placement intact.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from rocnrdma_tpu.utils.trace import trace
+
+_FORMAT_VERSION = 1
+
+
+def _flatten_with_paths(tree) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for keypath, leaf in flat:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in keypath)
+        out.append((path, leaf))
+    return out
+
+
+def _extended_dtype(name: str):
+    """Resolve ml_dtypes extended dtypes (bfloat16, fp8 families) that
+    plain numpy can't name."""
+    import ml_dtypes
+
+    return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_leaf(arr: np.ndarray):
+    """npz can't round-trip ml_dtypes leaves (they save as raw void and
+    refuse to cast back); store them bit-exact as unsigned ints plus a
+    dtype tag."""
+    try:
+        builtin = np.dtype(arr.dtype.char) == arr.dtype and \
+            arr.dtype.kind != "V"
+    except TypeError:
+        builtin = False
+    if not builtin:
+        width = {1: np.uint8, 2: np.uint16, 4: np.uint32}[arr.dtype.itemsize]
+        return arr.view(width), arr.dtype.name
+    return arr, None
+
+
+def save_checkpoint(path: str, trainer, step: int) -> None:
+    """Write params + optimizer state + step to ``path`` (.npz)."""
+    arrays: Dict[str, np.ndarray] = {}
+    for prefix, tree in (("params", trainer.params),
+                         ("opt", trainer.opt_state)):
+        for leaf_path, leaf in _flatten_with_paths(tree):
+            enc, tag = _encode_leaf(np.asarray(leaf))
+            key = f"{prefix}/{leaf_path}"
+            arrays[key] = enc
+            if tag is not None:
+                arrays[f"__dtype__/{key}"] = np.frombuffer(
+                    tag.encode(), dtype=np.uint8)
+    arrays["__meta__/step"] = np.asarray(step, dtype=np.int64)
+    arrays["__meta__/config"] = np.frombuffer(
+        trainer.cfg.name.encode(), dtype=np.uint8)
+    arrays["__meta__/version"] = np.asarray(_FORMAT_VERSION)
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)  # atomic publish — no torn checkpoints
+    trace.event("ckpt.save", path=path, step=step)
+
+
+def restore_checkpoint(path: str, trainer) -> int:
+    """Restore in place onto the trainer's shardings; returns step."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    with np.load(path) as z:
+        cfg_name = bytes(z["__meta__/config"]).decode()
+        if cfg_name != trainer.cfg.name:
+            raise ValueError(
+                f"checkpoint is for config {cfg_name!r}, trainer is "
+                f"{trainer.cfg.name!r}")
+        step = int(z["__meta__/step"])
+
+        def rebuild(prefix: str, template):
+            flat = _flatten_with_paths(template)
+            leaves = []
+            for leaf_path, old_leaf in flat:
+                key = f"{prefix}/{leaf_path}"
+                if key not in z:
+                    raise ValueError(f"checkpoint missing leaf {key}")
+                arr = z[key]
+                tag_key = f"__dtype__/{key}"
+                if tag_key in z:
+                    arr = arr.view(_extended_dtype(
+                        bytes(z[tag_key]).decode()))
+                if hasattr(old_leaf, "sharding"):
+                    arr = jax.device_put(
+                        arr.astype(old_leaf.dtype), old_leaf.sharding)
+                leaves.append(arr)
+            treedef = jax.tree_util.tree_structure(template)
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        trainer.params = rebuild("params", trainer.params)
+        trainer.opt_state = rebuild("opt", trainer.opt_state)
+    trace.event("ckpt.restore", path=path, step=step)
+    return step

@@ -1,0 +1,87 @@
+"""Device mesh + sharding rules — the intra-slice parallelism story.
+
+Per SURVEY.md §2's parallelism inventory, intra-slice parallelism is
+delegated to XLA/pjit over ICI: we pick a mesh, annotate shardings
+(data-parallel batch on ``dp``, tensor-parallel heads/ffn/vocab on
+``tp``), and let XLA insert the collectives. The framework's own
+transport only owns the cross-slice (DCN) hop — see
+``parallel.trainer`` and ``collectives.jax_shim``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(shape: Dict[str, int],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh from axis-name → size, e.g. {"dp": 2, "tp": 4}."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    total = int(np.prod(list(shape.values())))
+    if total > len(devs):
+        raise ValueError(f"mesh {shape} needs {total} devices, "
+                         f"have {len(devs)}")
+    arr = np.array(devs[:total]).reshape(tuple(shape.values()))
+    return Mesh(arr, tuple(shape.keys()))
+
+
+def batch_spec() -> P:
+    """Tokens (B, S): batch on dp."""
+    return P("dp", None)
+
+
+def param_spec(path: str) -> P:
+    """Tensor-parallel partitioning for Llama params by param path.
+
+    Column-parallel (shard the output features): wq/wk/wv, w_gate,
+    w_up, lm_head. Row-parallel (shard the input features): wo,
+    w_down. Embedding shards the vocab axis. Norms replicate. XLA
+    derives the matching all-reduces from these placements.
+    """
+    if "embed" in path:
+        return P("tp", None)
+    if any(k in path for k in ("wq", "wk", "wv")):
+        return P(None, "tp")
+    if "wo" in path:
+        return P("tp", None)
+    if any(k in path for k in ("w_gate", "w_up")):
+        return P(None, "tp")
+    if "w_down" in path:
+        return P("tp", None)
+    if "lm_head" in path:
+        return P(None, "tp")
+    return P()  # norms and anything residual: replicated
+
+
+def param_shardings(mesh: Mesh, params):
+    """Pytree of NamedShardings matching param_spec by tree path."""
+
+    def one(path_parts, leaf):
+        path = "/".join(str(p) for p in path_parts)
+        spec = param_spec(path)
+        # Fall back to replication when a spec doesn't divide evenly
+        # (tiny test configs with odd head counts).
+        try:
+            for axis_name, dim in zip(spec, range(leaf.ndim)):
+                if axis_name is None:
+                    continue
+                if leaf.shape[dim] % mesh.shape[axis_name] != 0:
+                    return NamedSharding(mesh, P())
+        except Exception:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, spec)
+
+    import jax.tree_util as jtu
+
+    return jtu.tree_map_with_path(
+        lambda kp, leaf: one([getattr(k, "key", getattr(k, "idx", k))
+                              for k in kp], leaf),
+        params)
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())

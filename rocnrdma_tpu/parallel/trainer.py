@@ -1,0 +1,127 @@
+"""DP trainer — the end-to-end consumer (BASELINE.md config 4).
+
+Training topology mirrors the multi-slice JAX setup the baseline
+names (Llama-3 DP across 2 slices of v5e-8):
+
+- **Intra-slice**: one jitted train step over the slice's mesh
+  (dp × tp), shardings from ``parallel.mesh``; XLA's ICI collectives
+  handle everything inside the slice.
+- **Cross-slice**: gradient allreduce between slices rides this
+  framework's transport (``CrossSliceAllReduce`` → ring over RDMA),
+  replacing XLA's host-staged DCN path — the reason this framework
+  exists (SURVEY.md §5 "Distributed communication backend").
+
+When a cross-slice hook is installed the step splits into
+grad-compute and apply so the sync sits between them; without it the
+whole step is one fused jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from rocnrdma_tpu.models.llama import (
+    Llama, LlamaConfig, cross_entropy_loss, make_model)
+from rocnrdma_tpu.parallel.mesh import (
+    batch_spec, make_mesh, param_shardings, replicated)
+from rocnrdma_tpu.utils.trace import trace
+
+
+def loss_fn(model: Llama, params, tokens) -> jnp.ndarray:
+    """Next-token cross entropy on (B, S) int32 tokens."""
+    logits = model.apply(params, tokens[:, :-1])
+    return cross_entropy_loss(logits, tokens[:, 1:])
+
+
+class Trainer:
+    def __init__(
+        self,
+        config: "LlamaConfig | str",
+        mesh_shape: Optional[Dict[str, int]] = None,
+        learning_rate: float = 3e-4,
+        weight_decay: float = 0.1,
+        cross_slice_sync: Optional[Callable[[Any], Any]] = None,
+        devices=None,
+        seed: int = 0,
+    ):
+        self.model = make_model(config)
+        self.cfg = self.model.cfg
+        self.mesh = make_mesh(mesh_shape or {"dp": 1, "tp": 1}, devices)
+        self.tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+        self.cross_slice_sync = cross_slice_sync
+
+        rng = jax.random.PRNGKey(seed)
+        with self.mesh:
+            abstract = jax.eval_shape(
+                lambda r: self.model.init(
+                    r, jnp.zeros((1, 8), dtype=jnp.int32)), rng)
+            self._pshard = param_shardings(self.mesh, abstract)
+            init_fn = jax.jit(
+                lambda r: self.model.init(
+                    r, jnp.zeros((1, 8), dtype=jnp.int32)),
+                out_shardings=self._pshard)
+            self.params = init_fn(rng)
+            opt_abstract = jax.eval_shape(self.tx.init, abstract)
+            self._oshard = jax.tree_util.tree_map(
+                lambda _: replicated(self.mesh), opt_abstract,
+                is_leaf=lambda x: hasattr(x, "shape"))
+            self.opt_state = jax.jit(
+                self.tx.init, out_shardings=self._oshard)(self.params)
+
+        data_sharding = NamedSharding(self.mesh, batch_spec())
+
+        def grads_of(params, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(self.model, p, tokens))(params)
+            return loss, grads
+
+        def apply(params, opt_state, grads):
+            updates, new_opt = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        def full_step(params, opt_state, tokens):
+            loss, grads = grads_of(params, tokens)
+            new_params, new_opt = apply(params, opt_state, grads)
+            return new_params, new_opt, loss
+
+        with self.mesh:
+            self._jit_grads = jax.jit(
+                grads_of,
+                in_shardings=(self._pshard, data_sharding),
+                out_shardings=(replicated(self.mesh), self._pshard))
+            self._jit_apply = jax.jit(
+                apply,
+                in_shardings=(self._pshard, self._oshard, self._pshard),
+                out_shardings=(self._pshard, self._oshard))
+            self._jit_full = jax.jit(
+                full_step,
+                in_shardings=(self._pshard, self._oshard, data_sharding),
+                out_shardings=(self._pshard, self._oshard,
+                               replicated(self.mesh)))
+        self._data_sharding = data_sharding
+
+    def shard_batch(self, tokens):
+        return jax.device_put(tokens, self._data_sharding)
+
+    def step(self, tokens) -> float:
+        """One optimizer step; returns the (pre-update) loss."""
+        tokens = self.shard_batch(tokens)
+        with self.mesh:
+            if self.cross_slice_sync is None:
+                self.params, self.opt_state, loss = self._jit_full(
+                    self.params, self.opt_state, tokens)
+            else:
+                loss, grads = self._jit_grads(self.params, tokens)
+                # The cross-slice hop: grads averaged across slices
+                # over the RDMA transport (staged fallback accounts
+                # its bytes), then applied locally.
+                grads = self.cross_slice_sync(grads)
+                self.params, self.opt_state = self._jit_apply(
+                    self.params, self.opt_state, grads)
+        trace.event("trainer.step", loss=float(loss))
+        return float(loss)

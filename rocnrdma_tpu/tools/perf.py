@@ -1,0 +1,194 @@
+"""tdr_perf — the perftest (`ib_write_bw` / `ib_read_bw`) analogue.
+
+The reference's README mandates IB Verbs traffic and the de-facto E2E
+tool for its driver class is Mellanox perftest (SURVEY.md §4 "implied
+external tests"); BASELINE.json configs 0-2 adopt it explicitly. This
+tool reproduces that workflow over the framework engine, so the same
+sweep runs on a NIC-less dev box (emu backend), over SoftRoCE, or on
+real HCAs with TPU-HBM MRs (verbs backend + dma-buf registration).
+
+Usage:
+  server:  python -m rocnrdma_tpu.tools.perf --listen --port 18515
+  client:  python -m rocnrdma_tpu.tools.perf --host 1.2.3.4 --port 18515 \
+               --op write --sizes 4:1G --iters 16
+  loopback (both ends in one process, the config-0 control):
+  python -m rocnrdma_tpu.tools.perf --loopback --op write
+
+Memory source: --hbm fake pins the buffer through the HBM registration
+manager (FakeHBMExporter + dma-buf path) instead of plain malloc'd
+host memory, exercising the full §3.2 registration stack under the
+sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import List
+
+import numpy as np
+
+
+def parse_sizes(spec: str) -> List[int]:
+    """"4:1G" → powers of two from 4 B to 1 GiB inclusive."""
+    def one(s: str) -> int:
+        s = s.strip().upper()
+        mult = 1
+        for suffix, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30)):
+            if s.endswith(suffix):
+                mult = m
+                s = s[:-1]
+        return int(s) * mult
+
+    if ":" in spec:
+        lo, hi = (one(p) for p in spec.split(":"))
+        sizes = []
+        n = lo
+        while n <= hi:
+            sizes.append(n)
+            n *= 2
+        return sizes
+    return [one(spec)]
+
+
+def _mr_for(engine, nbytes: int, hbm: str):
+    """Buffer + MR via the requested memory source."""
+    if hbm == "fake":
+        from rocnrdma_tpu.hbm.registry import (
+            FakeHBMExporter, RegistrationManager)
+
+        exporter = FakeHBMExporter()
+        mgr = RegistrationManager(engine, exporter)
+        va = exporter.alloc(nbytes)
+        reg = mgr.register(va, nbytes)
+        return reg.mr, (mgr, reg)
+    buf = np.zeros(nbytes, dtype=np.uint8)
+    return engine.reg_mr(buf), buf  # keep buf alive
+
+
+def run_peer(engine, qp, sizes: List[int], op: str, iters: int,
+             is_client: bool, hbm: str, out=sys.stdout):
+    from rocnrdma_tpu.transport import engine as eng
+
+    max_size = max(sizes)
+    mr, keep = _mr_for(engine, max_size, hbm)
+
+    # Exchange MR info over the data QP via SEND/RECV (the role
+    # perftest's TCP side-channel plays).
+    info = np.array([mr.addr, mr.rkey], dtype=np.uint64)
+    inbox = np.zeros(2, dtype=np.uint64)
+    with engine.reg_mr(info) as imr, engine.reg_mr(inbox) as rmr:
+        qp.post_recv(rmr, 0, 16, wr_id=1)
+        qp.post_send(imr, 0, 16, wr_id=2)
+        got = {c.wr_id: c for c in qp.poll(2, timeout_ms=30000)}
+        while len(got) < 2:
+            for c in qp.poll(2, timeout_ms=30000):
+                got[c.wr_id] = c
+        raddr, rkey = int(inbox[0]), int(inbox[1])
+
+    results = []
+    if is_client:
+        post = qp.post_write if op == "write" else qp.post_read
+        for size in sizes:
+            post(mr, 0, raddr, rkey, size, wr_id=0)  # warmup
+            assert qp.wait(0, timeout_ms=120000).ok
+            t0 = time.perf_counter()
+            for i in range(iters):
+                post(mr, 0, raddr, rkey, size, wr_id=i + 1)
+                assert qp.wait(i + 1, timeout_ms=120000).ok
+            dt = time.perf_counter() - t0
+            bw = size * iters / dt / 1e9
+            lat_us = dt / iters * 1e6
+            results.append({"bytes": size, "GBps": round(bw, 4),
+                            "lat_us": round(lat_us, 2)})
+            print(f"{size:>12}  {bw:10.3f} GB/s  {lat_us:10.2f} us",
+                  file=out, flush=True)
+        # Tell the server we're done.
+        done = np.zeros(1, dtype=np.uint8)
+        with engine.reg_mr(done) as dmr_:
+            qp.post_send(dmr_, 0, 1, wr_id=99)
+            qp.wait(99, timeout_ms=30000)
+    else:
+        # Server: passive for one-sided traffic; wait for the client's
+        # done marker (zero software on the data path, SURVEY.md §3.3).
+        done = np.zeros(1, dtype=np.uint8)
+        with engine.reg_mr(done) as dmr_:
+            qp.post_recv(dmr_, 0, 1, wr_id=99)
+            qp.wait(99, timeout_ms=600000)
+    if hbm == "fake":
+        mgr, reg = keep
+        mgr.deregister(reg)
+    else:
+        mr.deregister()
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tdr_perf", description=__doc__)
+    ap.add_argument("--listen", action="store_true")
+    ap.add_argument("--loopback", action="store_true")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--bind", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=18515)
+    ap.add_argument("--op", choices=["write", "read"], default="write")
+    ap.add_argument("--sizes", default="4:1G")
+    ap.add_argument("--iters", type=int, default=16)
+    ap.add_argument("--engine", default=None,
+                    help="emu | verbs[:dev] | auto (default: TDR_ENGINE)")
+    ap.add_argument("--hbm", choices=["host", "fake"], default="host",
+                    help="register plain host memory or fake-HBM pins")
+    ap.add_argument("--json", action="store_true",
+                    help="print a JSON summary line at the end")
+    args = ap.parse_args(argv)
+
+    from rocnrdma_tpu.transport.engine import Engine
+    from rocnrdma_tpu.utils.config import get_config
+
+    spec = args.engine or get_config().engine
+    sizes = parse_sizes(args.sizes)
+
+    if args.loopback:
+        e = Engine(spec)
+        srv_qp = [None]
+
+        def serve():
+            srv_qp[0] = e.listen("127.0.0.1", args.port)
+
+        t = threading.Thread(target=serve)
+        t.start()
+        cli = e.connect("127.0.0.1", args.port)
+        t.join()
+        st = threading.Thread(
+            target=run_peer,
+            args=(e, srv_qp[0], sizes, args.op, args.iters, False,
+                  args.hbm))
+        st.start()
+        results = run_peer(e, cli, sizes, args.op, args.iters, True,
+                           args.hbm)
+        st.join()
+        srv_qp[0].close(); cli.close(); e.close()
+    elif args.listen:
+        e = Engine(spec)
+        qp = e.listen(args.bind, args.port)
+        results = run_peer(e, qp, sizes, args.op, args.iters, False,
+                           args.hbm)
+        qp.close(); e.close()
+    else:
+        e = Engine(spec)
+        qp = e.connect(args.host, args.port, timeout_ms=60000)
+        results = run_peer(e, qp, sizes, args.op, args.iters, True,
+                           args.hbm)
+        qp.close(); e.close()
+
+    if args.json and results:
+        peak = max(r["GBps"] for r in results)
+        print(json.dumps({"op": args.op, "peak_GBps": peak,
+                          "sweep": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

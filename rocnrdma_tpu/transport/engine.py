@@ -131,6 +131,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_poll.argtypes = [P, ctypes.POINTER(Wc), ctypes.c_int, ctypes.c_int]
     lib.tdr_ring_create.restype = P
     lib.tdr_ring_create.argtypes = [P, P, P, ctypes.c_int, ctypes.c_int]
+    lib.tdr_ring_register.restype = ctypes.c_int
+    lib.tdr_ring_register.argtypes = [P, P, ctypes.c_size_t]
+    lib.tdr_ring_unregister.restype = ctypes.c_int
+    lib.tdr_ring_unregister.argtypes = [P, P]
     lib.tdr_ring_allreduce.restype = ctypes.c_int
     lib.tdr_ring_allreduce.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
@@ -292,6 +296,17 @@ class Ring:
                                           rank, world)
         _check(self._h, "ring_create")
         self.rank, self.world = rank, world
+
+    def register_buffer(self, array) -> None:
+        """Front-load MR registration for a buffer the caller promises
+        stable for the ring's lifetime; subsequent allreduces on it do
+        no registration work (the reference's zero-software-hot-path
+        invariant). Unregistered buffers still work — registered per
+        call."""
+        rc = _load().tdr_ring_register(
+            _live(self._h, "ring_register"), array.ctypes.data,
+            array.nbytes)
+        _check(rc == 0, "ring_register")
 
     def allreduce(self, array, op: int = RED_SUM) -> None:
         """In-place allreduce of a C-contiguous numpy array (ctypes

@@ -7,11 +7,26 @@ tests use the emulated engine backend, which needs no NIC.
 """
 
 import os
+import sys
 
 # Must be set before jax is imported anywhere in the test process.
 # Hard-set (not setdefault): the ambient environment may point JAX at a
 # real TPU, but the test suite is defined to be hardware-free.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The ambient environment may inject a TPU PJRT plugin via a
+# sitecustomize hook that imports jax at interpreter startup — before
+# this conftest runs — with JAX_PLATFORMS pointing at a device tunnel
+# that hangs when unreachable. Env vars are too late by then; force the
+# already-imported jax onto CPU through its config API.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":")
+    if p and ".axon_site" not in p)
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -98,20 +98,23 @@ def test_allreduce_max():
         w.close()
 
 
-def test_allreduce_repeated_reuses_registrations():
-    """Steady-state allreduces must not re-register buffers — the
-    front-loaded-registration invariant (BASELINE.md 'zero software on
-    the hot path')."""
+def test_allreduce_registered_buffers_skip_reregistration():
+    """Steady-state allreduces on pre-registered buffers must not
+    re-register — the front-loaded-registration invariant (BASELINE.md
+    'zero software on the hot path'). Unregistered buffers register
+    per call (safe for allocator-recycled addresses)."""
     from rocnrdma_tpu.utils.trace import trace
 
     worlds = local_worlds(2, free_port() + 100)
     bufs = [np.ones(8192, dtype=np.float32) for _ in range(2)]
+    for r in range(2):
+        worlds[r].ring.register_buffer(bufs[r])
     run_ranks(worlds, lambda w, r: w.allreduce(bufs[r]))
     regs_after_first = trace.counter("mr.reg")
 
     for _ in range(5):
         run_ranks(worlds, lambda w, r: w.allreduce(bufs[r]))
-    # Same buffers, same rings: no new MRs.
+    # Same pre-registered buffers, same rings: no new MRs.
     assert trace.counter("mr.reg") == regs_after_first
     for w in worlds:
         w.close()
