@@ -70,6 +70,10 @@ def bench_allreduce(count=(1 << 30) // 4, world=2, iters=3):
 
     worlds = local_worlds(world, port + 1000)
     bufs = [np.ones(count, dtype=np.float32) for _ in range(world)]
+    # Front-load MR registration (the reference's invariant): the timed
+    # loop must post work requests only.
+    for r in range(world):
+        worlds[r].ring.register_buffer(bufs[r])
 
     def run_all():
         ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))

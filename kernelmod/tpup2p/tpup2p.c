@@ -60,6 +60,10 @@ struct t2p_claim {
 	u64 va;
 	u64 len;
 	pid_t tgid;
+	/* fd the claim was made through; claims die with it (the per-fd
+	 * cleanup discipline of the reference's test module,
+	 * tests/amdp2ptest.c:115-139, applied to the bridge itself) */
+	struct file *owner;
 	/* dma-buf reference held from claim to unclaim */
 	struct dma_buf *dbuf;
 	u64 dbuf_offset;
@@ -128,8 +132,10 @@ static void *t2p_invalidate_handle;
 static invalidate_peer_memory t2p_invalidate_cb;
 
 /* Claim-table lookup for sibling modules (tpup2ptest). Returns the
- * dma-buf backing [va, va+len) for the calling process, or NULL; the
- * caller takes no reference — it must get_dma_buf() if it keeps it. */
+ * dma-buf backing [va, va+len) for the calling process with a
+ * reference held (taken under the claims lock, so a racing unclaim
+ * cannot free it first), or NULL. The caller owns the reference and
+ * must dma_buf_put() it. */
 struct dma_buf *tpup2p_resolve_claim(u64 va, u64 len, u64 *offset)
 {
 	struct t2p_claim *c;
@@ -139,6 +145,7 @@ struct dma_buf *tpup2p_resolve_claim(u64 va, u64 len, u64 *offset)
 	c = t2p_claim_find(va, len, task_tgid_nr(current));
 	if (c) {
 		dbuf = c->dbuf;
+		get_dma_buf(dbuf);
 		*offset = c->dbuf_offset + (va - c->va);
 	}
 	mutex_unlock(&t2p_claims_lock);
@@ -343,7 +350,7 @@ static const struct peer_memory_client t2p_client = {
  * /dev/tpup2p — claim-management ioctls from the userspace runtime
  * ------------------------------------------------------------------ */
 
-static long t2p_ioctl_claim(unsigned long arg)
+static long t2p_ioctl_claim(struct file *filp, unsigned long arg)
 {
 	struct tpup2p_claim_param p;
 	struct t2p_claim *c;
@@ -358,6 +365,7 @@ static long t2p_ioctl_claim(unsigned long arg)
 	c->va = p.va;
 	c->len = p.len;
 	c->tgid = task_tgid_nr(current);
+	c->owner = filp;
 	c->dbuf_offset = p.dmabuf_offset;
 	c->dbuf = dma_buf_get(p.dmabuf_fd);
 	if (IS_ERR(c->dbuf)) {
@@ -396,11 +404,39 @@ static long t2p_ioctl_unclaim(unsigned long arg)
 	return 0;
 }
 
+/* Drop every claim owned by `filp` (NULL = all claims, the module-exit
+ * sweep). Dead-process claims cannot outlive their fd — the leak (and
+ * the tgid-reuse aliasing window) the reference's per-fd cleanup list
+ * closes for pins (tests/amdp2ptest.c:115-139), closed for claims. */
+static void t2p_reap_claims(struct file *filp)
+{
+	struct rb_node *n, *next;
+
+	mutex_lock(&t2p_claims_lock);
+	for (n = rb_first(&t2p_claims); n; n = next) {
+		struct t2p_claim *c = rb_entry(n, struct t2p_claim, node);
+
+		next = rb_next(n);
+		if (filp && c->owner != filp)
+			continue;
+		rb_erase(&c->node, &t2p_claims);
+		dma_buf_put(c->dbuf);
+		kfree(c);
+	}
+	mutex_unlock(&t2p_claims_lock);
+}
+
+static int t2p_release(struct inode *inode, struct file *filp)
+{
+	t2p_reap_claims(filp);
+	return 0;
+}
+
 static long t2p_ioctl(struct file *filp, unsigned int cmd, unsigned long arg)
 {
 	switch (cmd) {
 	case TPUP2P_IOC_CLAIM:
-		return t2p_ioctl_claim(arg);
+		return t2p_ioctl_claim(filp, arg);
 	case TPUP2P_IOC_UNCLAIM:
 		return t2p_ioctl_unclaim(arg);
 	default:
@@ -411,6 +447,7 @@ static long t2p_ioctl(struct file *filp, unsigned int cmd, unsigned long arg)
 static const struct file_operations t2p_fops = {
 	.owner = THIS_MODULE,
 	.unlocked_ioctl = t2p_ioctl,
+	.release = t2p_release,
 };
 
 static struct miscdevice t2p_misc = {
@@ -443,6 +480,7 @@ static void __exit tpup2p_exit(void)
 {
 	ib_unregister_peer_memory_client(t2p_invalidate_handle);
 	misc_deregister(&t2p_misc);
+	t2p_reap_claims(NULL);	/* drop any claims that outlived their fd */
 }
 
 module_init(tpup2p_init);

@@ -52,7 +52,8 @@ struct t2pt_file {
 
 static struct device *t2pt_misc_dev_parent(void);
 
-/* Resolution hook into the bridge's claim table. Out-of-tree builds
+/* Resolution hook into the bridge's claim table; returns the dma-buf
+ * with a reference held (caller must dma_buf_put). Out-of-tree builds
  * without tpup2p fall back to treating the VA as a dma-buf fd carried
  * in the upper bits — test-only convenience. */
 extern struct dma_buf *tpup2p_resolve_claim(u64 va, u64 len, u64 *offset)
@@ -103,12 +104,16 @@ static int t2pt_release(struct inode *inode, struct file *filp)
 static long t2pt_ioctl_query(unsigned long arg)
 {
 	struct tpup2ptest_query_param p;
+	struct dma_buf *dbuf = NULL;
 	u64 off;
 
 	if (copy_from_user(&p, (void __user *)arg, sizeof(p)))
 		return -EFAULT;
-	p.is_device = tpup2p_resolve_claim &&
-		      tpup2p_resolve_claim(p.va, p.len, &off) != NULL;
+	if (tpup2p_resolve_claim)
+		dbuf = tpup2p_resolve_claim(p.va, p.len, &off);
+	p.is_device = dbuf != NULL;
+	if (dbuf)
+		dma_buf_put(dbuf);	/* resolve returns a held reference */
 	t2pt_dbg("query va=%llx len=%llu -> %u\n", p.va, p.len, p.is_device);
 	if (copy_to_user((void __user *)arg, &p, sizeof(p)))
 		return -EFAULT;
@@ -132,12 +137,13 @@ static long t2pt_ioctl_pin(struct t2pt_file *tf, unsigned long arg)
 		return -ENOMEM;
 	pin->va = p.va;
 	pin->len = p.len;
+	/* resolve_claim returns with a reference held (taken under the
+	 * claim lock — no unclaim race window); the pin owns it now. */
 	pin->dbuf = tpup2p_resolve_claim(p.va, p.len, &off);
 	if (!pin->dbuf) {
 		kfree(pin);
 		return -ENXIO;
 	}
-	get_dma_buf(pin->dbuf);
 
 	pin->att = dma_buf_attach(pin->dbuf, t2pt_misc_dev_parent());
 	if (IS_ERR(pin->att)) {

@@ -62,6 +62,11 @@ class CrossSliceAllReduce:
     def _stage(self, dtype_str: str, count: int) -> np.ndarray:
         buf = self._staging.get(dtype_str)
         if buf is None or buf.size < count:
+            if buf is not None:
+                # Unpin the outgrown buffer before dropping it — a
+                # stale MR over freed memory could alias a recycled
+                # allocation (and on verbs it pins the old pages).
+                self.world.ring.unregister_buffer(buf)
             buf = np.empty(count, dtype=dtype_str)
             self._staging[dtype_str] = buf
             self.world.ring.register_buffer(buf)

@@ -117,6 +117,16 @@ int tdr_post_send(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t len,
 int tdr_post_recv(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t maxlen,
                   uint64_t wr_id);
 
+/* Fused reduce-on-receive (the SHARP-style in-transport reduction):
+ * like tdr_post_recv, but the inbound SEND payload is folded into the
+ * buffer (dst op= src, with TDR_DT_ / TDR_RED_ semantics) by the
+ * progress engine — no scratch buffer or second pass. Capability-gated:
+ * tdr_qp_has_recv_reduce() returns 1 on engines that support it (emu);
+ * on others the post fails and callers fall back to recv + reduce. */
+int tdr_post_recv_reduce(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t maxlen,
+                         int dtype, int red_op, uint64_t wr_id);
+int tdr_qp_has_recv_reduce(tdr_qp *qp);
+
 /* Poll up to `max` completions; waits up to timeout_ms (0 = non-block,
  * -1 = forever). Returns count, or -1 on error. */
 int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms);

@@ -119,6 +119,16 @@ int tdr_post_recv(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t maxlen,
                                                loff, maxlen, wr_id);
 }
 
+int tdr_post_recv_reduce(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t maxlen,
+                         int dtype, int red_op, uint64_t wr_id) {
+  return reinterpret_cast<Qp *>(qp)->post_recv_reduce(
+      reinterpret_cast<Mr *>(lmr), loff, maxlen, dtype, red_op, wr_id);
+}
+
+int tdr_qp_has_recv_reduce(tdr_qp *qp) {
+  return reinterpret_cast<Qp *>(qp)->has_recv_reduce() ? 1 : 0;
+}
+
 int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms) {
   return reinterpret_cast<Qp *>(qp)->poll(wc, max, timeout_ms);
 }

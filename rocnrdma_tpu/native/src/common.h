@@ -41,6 +41,16 @@ class Qp {
   virtual int post_send(Mr *lmr, size_t loff, size_t len, uint64_t wr_id) = 0;
   virtual int post_recv(Mr *lmr, size_t loff, size_t maxlen,
                         uint64_t wr_id) = 0;
+  // Fused reduce-on-receive (the SHARP-style offload): the inbound
+  // SEND payload is folded into the recv buffer (dst op= src) by the
+  // progress engine instead of overwriting it — no scratch buffer, no
+  // second pass. Engines without the capability return -1.
+  virtual int post_recv_reduce(Mr *, size_t, size_t, int /*dtype*/,
+                               int /*red_op*/, uint64_t) {
+    set_error("recv_reduce: not supported by this engine");
+    return -1;
+  }
+  virtual bool has_recv_reduce() const { return false; }
   virtual int poll(tdr_wc *wc, int max, int timeout_ms) = 0;
   virtual int close_qp() = 0;
 };
@@ -60,6 +70,12 @@ class Engine {
 
 Engine *create_emu_engine(std::string *err);
 Engine *create_verbs_engine(const std::string &device, std::string *err);
+
+// Element size for a TDR_DT_*; 0 for unknown.
+size_t dtype_size(int dt);
+// dst[i] op= src[i] for n elements of dtype dt (bf16 accumulates in
+// f32 with round-to-nearest-even, matching TPU semantics).
+void reduce_any(void *dst, const void *src, size_t n, int dt, int op);
 
 // TCP helpers (bootstrap for both backends; data path for emu).
 int tcp_listen_accept(const char *bind_host, int port, std::string *err);

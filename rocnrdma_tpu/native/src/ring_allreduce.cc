@@ -6,8 +6,15 @@
 // gradient sync rides. Buffers are registered once per (buffer, ring)
 // pair and cached, preserving the reference's front-loaded-registration
 // invariant: steady-state steps post work requests only.
+//
+// Large segments are split into chunks (TDR_RING_CHUNK, default 8 MiB)
+// with a small window of pre-posted receives, so the wire transfer of
+// chunk i+1 overlaps the reduction of chunk i and the link never idles
+// behind the ALU.
 
+#include <algorithm>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <unordered_map>
@@ -18,96 +25,27 @@
 
 namespace {
 
-size_t dtype_size(int dt) {
-  switch (dt) {
-    case TDR_DT_F32:
-    case TDR_DT_I32:
-      return 4;
-    case TDR_DT_F64:
-    case TDR_DT_I64:
-      return 8;
-    case TDR_DT_BF16:
-      return 2;
-    default:
-      return 0;
+constexpr size_t kDefaultChunk = 8u << 20;
+constexpr int kWindow = 4;  // pre-posted recv slots per step
+
+size_t ring_chunk_bytes() {
+  const char *env = getenv("TDR_RING_CHUNK");
+  if (env && *env) {
+    long long v = atoll(env);
+    if (v >= 4096) return static_cast<size_t>(v);
   }
+  return kDefaultChunk;
 }
 
-float bf16_to_f32(uint16_t v) {
-  uint32_t u = static_cast<uint32_t>(v) << 16;
-  float f;
-  memcpy(&f, &u, 4);
-  return f;
-}
+using tdr::dtype_size;
+using tdr::reduce_any;
 
-uint16_t f32_to_bf16(float f) {
-  uint32_t u;
-  memcpy(&u, &f, 4);
-  // round-to-nearest-even, matching TPU bf16 semantics
-  uint32_t rounding = 0x7fff + ((u >> 16) & 1);
-  return static_cast<uint16_t>((u + rounding) >> 16);
-}
-
-template <typename T>
-void reduce_typed(T *dst, const T *src, size_t n, int op) {
-  switch (op) {
-    case TDR_RED_SUM:
-      for (size_t i = 0; i < n; i++) dst[i] += src[i];
-      break;
-    case TDR_RED_MAX:
-      for (size_t i = 0; i < n; i++)
-        if (src[i] > dst[i]) dst[i] = src[i];
-      break;
-    case TDR_RED_MIN:
-      for (size_t i = 0; i < n; i++)
-        if (src[i] < dst[i]) dst[i] = src[i];
-      break;
-  }
-}
-
-void reduce_bf16(uint16_t *dst, const uint16_t *src, size_t n, int op) {
-  for (size_t i = 0; i < n; i++) {
-    float a = bf16_to_f32(dst[i]), b = bf16_to_f32(src[i]);
-    float r = a;
-    switch (op) {
-      case TDR_RED_SUM:
-        r = a + b;
-        break;
-      case TDR_RED_MAX:
-        r = b > a ? b : a;
-        break;
-      case TDR_RED_MIN:
-        r = b < a ? b : a;
-        break;
-    }
-    dst[i] = f32_to_bf16(r);
-  }
-}
-
-void reduce_any(void *dst, const void *src, size_t n, int dt, int op) {
-  switch (dt) {
-    case TDR_DT_F32:
-      reduce_typed(static_cast<float *>(dst), static_cast<const float *>(src),
-                   n, op);
-      break;
-    case TDR_DT_F64:
-      reduce_typed(static_cast<double *>(dst),
-                   static_cast<const double *>(src), n, op);
-      break;
-    case TDR_DT_I32:
-      reduce_typed(static_cast<int32_t *>(dst),
-                   static_cast<const int32_t *>(src), n, op);
-      break;
-    case TDR_DT_I64:
-      reduce_typed(static_cast<int64_t *>(dst),
-                   static_cast<const int64_t *>(src), n, op);
-      break;
-    case TDR_DT_BF16:
-      reduce_bf16(static_cast<uint16_t *>(dst),
-                  static_cast<const uint16_t *>(src), n, op);
-      break;
-  }
-}
+// wr_id tags for the pipeline: high 16 bits the kind, low bits the
+// chunk index, so one poll loop can route recv completions (in posted
+// order) and send acks (order-independent, only counted).
+constexpr uint64_t kWrRecv = 0x5245ull << 48;
+constexpr uint64_t kWrSend = 0x5345ull << 48;
+constexpr uint64_t kWrKindMask = 0xffffull << 48;
 
 }  // namespace
 
@@ -117,6 +55,7 @@ struct tdr_ring {
   tdr_qp *right;  // send to
   int rank;
   int world;
+  size_t chunk = kDefaultChunk;
   std::vector<char> tmp;
   tdr_mr *tmp_mr = nullptr;
   // MRs for buffers the CALLER promised stable (tdr_ring_register) —
@@ -167,6 +106,7 @@ tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
   r->right = right;
   r->rank = rank;
   r->world = world;
+  r->chunk = ring_chunk_bytes();
   return r;
 }
 
@@ -210,30 +150,152 @@ int tdr_ring_unregister(tdr_ring *r, void *base) {
   return 0;
 }
 
-// Wait for one completion with the given wr_id on qp; other completions
-// arriving first are held by the caller loop (each step has at most one
-// outstanding send + one recv per QP, so a two-slot check suffices).
-static int wait_wr(tdr_qp *qp, uint64_t want_a, uint64_t want_b, int *got_a,
-                   int *got_b) {
-  while (!(*got_a && *got_b)) {
-    tdr_wc wc[2];
-    int n = tdr_poll(qp, wc, 2, 30000);
-    if (n <= 0) {
-      tdr::set_error("ring: poll timeout/failure");
-      return -1;
+namespace {
+
+struct StepPipe {
+  tdr_ring *r;
+  tdr_mr *dmr;
+  char *cdata;
+  int dtype, red_op;
+  size_t esz;
+
+  // One neighbor-exchange step: stream `send_len` bytes of the data
+  // buffer at `send_off` rightward while receiving `recv_len` bytes
+  // from the left, chunked so transfer and reduction overlap.
+  //
+  // reduce=true → phase-1 semantics: inbound chunks are folded into
+  // data at recv_off. On engines with reduce-on-receive the fold
+  // happens in the transport's progress engine directly from the
+  // inbound bytes (no scratch at all); otherwise chunks land in a
+  // windowed scratch and are folded here.
+  // reduce=false → phase-2 semantics: receives land directly in the
+  // data MR at recv_off (no copy, no reduce).
+  int run(size_t send_off, size_t send_len, size_t recv_off, size_t recv_len,
+          bool reduce) {
+    const size_t chunk = r->chunk;
+    const size_t n_send = send_len ? (send_len + chunk - 1) / chunk : 0;
+    const size_t n_recv = recv_len ? (recv_len + chunk - 1) / chunk : 0;
+    const bool fused = reduce && tdr_qp_has_recv_reduce(r->left);
+    const bool windowed = reduce && !fused;
+    const size_t slots =
+        windowed ? (n_recv < static_cast<size_t>(kWindow)
+                        ? (n_recv ? n_recv : 1)
+                        : kWindow)
+                 : 0;
+    const size_t slot_bytes =
+        windowed ? std::min(chunk, recv_len ? recv_len : 1) : 0;
+    tdr_mr *tmr = nullptr;
+    if (windowed && n_recv) {
+      tmr = r->scratch(slots * slot_bytes);
+      if (!tmr) return -1;
     }
-    for (int i = 0; i < n; i++) {
-      if (wc[i].status != TDR_WC_SUCCESS) {
-        tdr::set_error("ring: completion error status " +
-                       std::to_string(wc[i].status));
+
+    auto chunk_len = [chunk](size_t total, size_t i) {
+      size_t start = i * chunk;
+      return std::min(chunk, total - start);
+    };
+
+    size_t posted_r = 0, done_r = 0, posted_s = 0, acked_s = 0;
+
+    auto post_recv_chunk = [&](size_t i) -> int {
+      size_t len = chunk_len(recv_len, i);
+      if (fused)
+        return tdr_post_recv_reduce(r->left, dmr, recv_off + i * chunk, len,
+                                    dtype, red_op, kWrRecv | i);
+      if (windowed) {
+        size_t slot = i % slots;
+        return tdr_post_recv(r->left, tmr, slot * slot_bytes, len,
+                             kWrRecv | i);
+      }
+      return tdr_post_recv(r->left, dmr, recv_off + i * chunk, len,
+                           kWrRecv | i);
+    };
+
+    // Receives without a slot dependency (phase 2, and fused phase 1 —
+    // disjoint folds straight into the data MR) are pre-posted in
+    // full so inbound chunks always have a landing target. Windowed
+    // phase-1 receives pre-post up to the scratch window.
+    size_t prepost = windowed ? std::min(n_recv, slots) : n_recv;
+    for (; posted_r < prepost; posted_r++)
+      if (post_recv_chunk(posted_r) != 0) return -1;
+
+    const bool same_qp = (r->left == r->right);
+    auto drain = [&](tdr_qp *qp, int timeout_ms) -> int {
+      tdr_wc wc[16];
+      int n = tdr_poll(qp, wc, 16, timeout_ms);
+      if (n < 0) return -1;
+      for (int i = 0; i < n; i++) {
+        if (wc[i].status != TDR_WC_SUCCESS) {
+          tdr::set_error("ring: completion error status " +
+                         std::to_string(wc[i].status));
+          return -1;
+        }
+        uint64_t kind = wc[i].wr_id & kWrKindMask;
+        if (kind == kWrSend) {
+          acked_s++;
+        } else if (kind == kWrRecv) {
+          // TCP FIFO + FIFO recv queue ⇒ recv completions arrive in
+          // chunk order; fold and recycle the slot.
+          size_t idx = wc[i].wr_id & ~kWrKindMask;
+          if (idx != done_r) {
+            tdr::set_error("ring: out-of-order recv completion");
+            return -1;
+          }
+          if (windowed) {
+            size_t len = chunk_len(recv_len, idx);
+            reduce_any(cdata + recv_off + idx * chunk,
+                       r->tmp.data() + (idx % slots) * slot_bytes, len / esz,
+                       dtype, red_op);
+          }
+          done_r++;
+          if (posted_r < n_recv) {
+            if (post_recv_chunk(posted_r) != 0) return -1;
+            posted_r++;
+          }
+        }
+      }
+      return n;
+    };
+
+    while (done_r < n_recv || acked_s < n_send) {
+      // Keep outbound traffic moving: in stream mode this blocks while
+      // the chunk drains into the socket (the progress thread lands
+      // inbound chunks concurrently); in CMA mode it just queues a
+      // descriptor. In phase 1 stay within the peer's recv window —
+      // the schedule is symmetric, so our reduce progress tracks the
+      // peer's posted recvs; racing ahead would push inbound messages
+      // onto the unexpected (bounce-buffer) path and double-copy them.
+      bool may_send = posted_s < n_send &&
+                      (!windowed || n_recv == 0 || posted_s < done_r + slots);
+      if (may_send) {
+        size_t len = chunk_len(send_len, posted_s);
+        if (tdr_post_send(r->right, dmr, send_off + posted_s * chunk, len,
+                          kWrSend | posted_s) != 0)
+          return -1;
+        posted_s++;
+        // Opportunistically reap without blocking so slots recycle.
+        if (drain(r->left, 0) < 0) return -1;
+        if (!same_qp && drain(r->right, 0) < 0) return -1;
+        continue;
+      }
+      // All sends posted: block for what remains.
+      bool need_recv = done_r < n_recv;
+      tdr_qp *qp = need_recv ? r->left : r->right;
+      int n = drain(qp, 30000);
+      if (n < 0) return -1;
+      if (n == 0) {
+        tdr::set_error("ring: poll timeout");
         return -1;
       }
-      if (wc[i].wr_id == want_a) *got_a = 1;
-      if (wc[i].wr_id == want_b) *got_b = 1;
+      if (!same_qp && need_recv && acked_s < n_send) {
+        if (drain(r->right, 0) < 0) return -1;
+      }
     }
+    return 0;
   }
-  return 0;
-}
+};
+
+}  // namespace
 
 int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                        int red_op) {
@@ -260,17 +322,10 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
     seg_len[i] = (base + (static_cast<size_t>(i) < rem ? 1 : 0)) * esz;
     off += base + (static_cast<size_t>(i) < rem ? 1 : 0);
   }
-  size_t max_seg = 0;
-  for (int i = 0; i < world; i++)
-    if (seg_len[i] > max_seg) max_seg = seg_len[i];
 
   bool owned = false;
   tdr_mr *dmr = r->data_mr(data, nbytes, &owned);
-  tdr_mr *tmr = max_seg ? r->scratch(max_seg) : nullptr;
-  if (!dmr || (max_seg && !tmr)) {
-    if (owned && dmr) tdr_dereg_mr(dmr);
-    return -1;
-  }
+  if (!dmr) return -1;
   struct OwnedGuard {
     tdr_mr *mr;
     bool active;
@@ -280,9 +335,7 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
   } guard{dmr, owned};
   (void)guard;
 
-  char *cdata = static_cast<char *>(data);
-  const bool same_qp = (r->left == r->right);
-  const uint64_t WR_SEND = 0x53454e44, WR_RECV = 0x52454356;
+  StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};
 
   // Phase 1: reduce-scatter. After step s, segment (rank-s-1) holds the
   // partial sum of s+2 ranks; after world-1 steps each rank owns the
@@ -290,27 +343,9 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
   for (int s = 0; s < world - 1; s++) {
     int send_seg = ((r->rank - s) % world + world) % world;
     int recv_seg = ((r->rank - s - 1) % world + world) % world;
-    if (seg_len[recv_seg] &&
-        tdr_post_recv(r->left, tmr, 0, seg_len[recv_seg], WR_RECV) != 0)
+    if (pipe.run(seg_off[send_seg], seg_len[send_seg], seg_off[recv_seg],
+                 seg_len[recv_seg], /*reduce=*/true) != 0)
       return -1;
-    if (seg_len[send_seg] &&
-        tdr_post_send(r->right, dmr, seg_off[send_seg], seg_len[send_seg],
-                      WR_SEND) != 0)
-      return -1;
-    int got_s = seg_len[send_seg] ? 0 : 1, got_r = seg_len[recv_seg] ? 0 : 1;
-    if (same_qp) {
-      if (wait_wr(r->left, WR_SEND, WR_RECV, &got_s, &got_r) != 0) return -1;
-    } else {
-      int one = 1;
-      if (!got_r && wait_wr(r->left, WR_RECV, WR_RECV, &got_r, &one) != 0)
-        return -1;
-      one = 1;
-      if (!got_s && wait_wr(r->right, WR_SEND, WR_SEND, &got_s, &one) != 0)
-        return -1;
-    }
-    if (seg_len[recv_seg])
-      reduce_any(cdata + seg_off[recv_seg], r->tmp.data(),
-                 seg_len[recv_seg] / esz, dtype, red_op);
   }
 
   // Phase 2: all-gather — fully-reduced segments circulate; received
@@ -318,25 +353,9 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
   for (int s = 0; s < world - 1; s++) {
     int send_seg = ((r->rank + 1 - s) % world + world) % world;
     int recv_seg = ((r->rank - s) % world + world) % world;
-    if (seg_len[recv_seg] &&
-        tdr_post_recv(r->left, dmr, seg_off[recv_seg], seg_len[recv_seg],
-                      WR_RECV) != 0)
+    if (pipe.run(seg_off[send_seg], seg_len[send_seg], seg_off[recv_seg],
+                 seg_len[recv_seg], /*reduce=*/false) != 0)
       return -1;
-    if (seg_len[send_seg] &&
-        tdr_post_send(r->right, dmr, seg_off[send_seg], seg_len[send_seg],
-                      WR_SEND) != 0)
-      return -1;
-    int got_s = seg_len[send_seg] ? 0 : 1, got_r = seg_len[recv_seg] ? 0 : 1;
-    if (same_qp) {
-      if (wait_wr(r->left, WR_SEND, WR_RECV, &got_s, &got_r) != 0) return -1;
-    } else {
-      int one = 1;
-      if (!got_r && wait_wr(r->left, WR_RECV, WR_RECV, &got_r, &one) != 0)
-        return -1;
-      one = 1;
-      if (!got_s && wait_wr(r->right, WR_SEND, WR_SEND, &got_s, &one) != 0)
-        return -1;
-    }
   }
   return 0;
 }

@@ -20,6 +20,101 @@ static thread_local std::string g_error;
 void set_error(const std::string &msg) { g_error = msg; }
 const char *get_error() { return g_error.c_str(); }
 
+size_t dtype_size(int dt) {
+  switch (dt) {
+    case TDR_DT_F32:
+    case TDR_DT_I32:
+      return 4;
+    case TDR_DT_F64:
+    case TDR_DT_I64:
+      return 8;
+    case TDR_DT_BF16:
+      return 2;
+    default:
+      return 0;
+  }
+}
+
+namespace {
+
+float bf16_to_f32(uint16_t v) {
+  uint32_t u = static_cast<uint32_t>(v) << 16;
+  float f;
+  memcpy(&f, &u, 4);
+  return f;
+}
+
+uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  memcpy(&u, &f, 4);
+  // round-to-nearest-even, matching TPU bf16 semantics
+  uint32_t rounding = 0x7fff + ((u >> 16) & 1);
+  return static_cast<uint16_t>((u + rounding) >> 16);
+}
+
+template <typename T>
+void reduce_typed(T *dst, const T *src, size_t n, int op) {
+  switch (op) {
+    case TDR_RED_SUM:
+      for (size_t i = 0; i < n; i++) dst[i] += src[i];
+      break;
+    case TDR_RED_MAX:
+      for (size_t i = 0; i < n; i++)
+        if (src[i] > dst[i]) dst[i] = src[i];
+      break;
+    case TDR_RED_MIN:
+      for (size_t i = 0; i < n; i++)
+        if (src[i] < dst[i]) dst[i] = src[i];
+      break;
+  }
+}
+
+void reduce_bf16(uint16_t *dst, const uint16_t *src, size_t n, int op) {
+  for (size_t i = 0; i < n; i++) {
+    float a = bf16_to_f32(dst[i]), b = bf16_to_f32(src[i]);
+    float r = a;
+    switch (op) {
+      case TDR_RED_SUM:
+        r = a + b;
+        break;
+      case TDR_RED_MAX:
+        r = b > a ? b : a;
+        break;
+      case TDR_RED_MIN:
+        r = b < a ? b : a;
+        break;
+    }
+    dst[i] = f32_to_bf16(r);
+  }
+}
+
+}  // namespace
+
+void reduce_any(void *dst, const void *src, size_t n, int dt, int op) {
+  switch (dt) {
+    case TDR_DT_F32:
+      reduce_typed(static_cast<float *>(dst), static_cast<const float *>(src),
+                   n, op);
+      break;
+    case TDR_DT_F64:
+      reduce_typed(static_cast<double *>(dst),
+                   static_cast<const double *>(src), n, op);
+      break;
+    case TDR_DT_I32:
+      reduce_typed(static_cast<int32_t *>(dst),
+                   static_cast<const int32_t *>(src), n, op);
+      break;
+    case TDR_DT_I64:
+      reduce_typed(static_cast<int64_t *>(dst),
+                   static_cast<const int64_t *>(src), n, op);
+      break;
+    case TDR_DT_BF16:
+      reduce_bf16(static_cast<uint16_t *>(dst),
+                  static_cast<const uint16_t *>(src), n, op);
+      break;
+  }
+}
+
 void tune_socket(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));

@@ -83,9 +83,15 @@ def run_peer(engine, qp, sizes: List[int], op: str, iters: int,
     with engine.reg_mr(info) as imr, engine.reg_mr(inbox) as rmr:
         qp.post_recv(rmr, 0, 16, wr_id=1)
         qp.post_send(imr, 0, 16, wr_id=2)
-        got = {c.wr_id: c for c in qp.poll(2, timeout_ms=30000)}
+        deadline = time.monotonic() + 60
+        got = {}
         while len(got) < 2:
+            if time.monotonic() > deadline:
+                raise RuntimeError("tdr_perf: MR-info exchange timed out")
             for c in qp.poll(2, timeout_ms=30000):
+                if not c.ok:
+                    raise RuntimeError(
+                        f"tdr_perf: MR-info exchange failed (status {c.status})")
                 got[c.wr_id] = c
         raddr, rkey = int(inbox[0]), int(inbox[1])
 

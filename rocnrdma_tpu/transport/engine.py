@@ -66,8 +66,10 @@ class Wc(ctypes.Structure):
 
 
 def _build_library() -> None:
+    # TUNE=native is safe here: build-on-demand always runs on the
+    # machine that will execute the library (the repo ships no .so).
     subprocess.run(
-        ["make", "-s", "-C", os.path.abspath(_NATIVE_DIR)],
+        ["make", "-s", "-C", os.path.abspath(_NATIVE_DIR), "TUNE=native"],
         check=True,
         capture_output=True,
     )
@@ -307,6 +309,13 @@ class Ring:
             _live(self._h, "ring_register"), array.ctypes.data,
             array.nbytes)
         _check(rc == 0, "ring_register")
+
+    def unregister_buffer(self, array) -> None:
+        """Drop the front-loaded MR for a buffer registered with
+        ``register_buffer`` (call before freeing the buffer)."""
+        rc = _load().tdr_ring_unregister(
+            _live(self._h, "ring_unregister"), array.ctypes.data)
+        _check(rc == 0, "ring_unregister")
 
     def allreduce(self, array, op: int = RED_SUM) -> None:
         """In-place allreduce of a C-contiguous numpy array (ctypes
