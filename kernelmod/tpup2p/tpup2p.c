@@ -426,7 +426,7 @@ static void t2p_reap_claims(struct file *filp)
 	mutex_unlock(&t2p_claims_lock);
 }
 
-static int t2p_release(struct inode *inode, struct file *filp)
+static int t2p_chardev_release(struct inode *inode, struct file *filp)
 {
 	t2p_reap_claims(filp);
 	return 0;
@@ -447,7 +447,7 @@ static long t2p_ioctl(struct file *filp, unsigned int cmd, unsigned long arg)
 static const struct file_operations t2p_fops = {
 	.owner = THIS_MODULE,
 	.unlocked_ioctl = t2p_ioctl,
-	.release = t2p_release,
+	.release = t2p_chardev_release,
 };
 
 static struct miscdevice t2p_misc = {

@@ -75,6 +75,10 @@ typedef struct {
 /* Last error message for the calling thread ("" if none). */
 const char *tdr_last_error(void);
 
+/* Number of workers in the process-wide parallel copy/reduce pool
+ * (the emulated NIC's DMA-engine array; TDR_COPY_THREADS overrides). */
+size_t tdr_copy_pool_workers(void);
+
 /* spec: "emu", "verbs", "verbs:<device>", or "auto" (verbs, else emu). */
 tdr_engine *tdr_engine_open(const char *spec);
 void tdr_engine_close(tdr_engine *e);

@@ -14,6 +14,8 @@ extern "C" {
 
 const char *tdr_last_error(void) { return tdr::get_error(); }
 
+size_t tdr_copy_pool_workers(void) { return tdr::copy_pool_workers(); }
+
 tdr_engine *tdr_engine_open(const char *spec) {
   std::string s = spec ? spec : "auto";
   std::string err;

@@ -2,6 +2,8 @@
 #ifndef TDR_COMMON_H_
 #define TDR_COMMON_H_
 
+#include <sys/types.h>
+
 #include <atomic>
 #include <cstdint>
 #include <cstring>
@@ -76,6 +78,26 @@ size_t dtype_size(int dt);
 // dst[i] op= src[i] for n elements of dtype dt (bf16 accumulates in
 // f32 with round-to-nearest-even, matching TPU semantics).
 void reduce_any(void *dst, const void *src, size_t n, int dt, int op);
+
+// Parallel data movement (copy_pool.cc): a process-wide worker pool —
+// the emulated NIC's DMA-engine array. All entry points fall back to
+// the serial path on 1-core machines or short lengths; parallel
+// reductions are bit-exact with serial ones (element-disjoint slices).
+size_t copy_pool_workers();
+void par_memcpy(void *dst, const void *src, size_t len);
+void par_reduce(void *dst, const void *src, size_t n, int dt, int op);
+// Cross-memory attach primitives (single copy between address spaces)
+// and their pool-parallel wrappers. The same-process fast path is
+// explicit: pass kCmaSameProcess to memcpy in-place. A raw pid is
+// never compared against getpid() — pid values are namespace-relative
+// and collide across containers (two "pid 1"s on one host).
+constexpr pid_t kCmaSameProcess = -1;
+bool cma_copy_from(pid_t pid, void *dst, uint64_t src, size_t len);
+bool cma_copy_to(pid_t pid, uint64_t dst, const void *src, size_t len);
+bool par_cma_copy_from(pid_t pid, void *dst, uint64_t src, size_t len);
+bool par_cma_copy_to(pid_t pid, uint64_t dst, const void *src, size_t len);
+bool par_cma_reduce_from(pid_t pid, void *dst, uint64_t src, size_t bytes,
+                         int dt, int op);
 
 // TCP helpers (bootstrap for both backends; data path for emu).
 int tcp_listen_accept(const char *bind_host, int port, std::string *err);

@@ -1,0 +1,259 @@
+// Parallel data movement — the emulated NIC's DMA-engine array.
+//
+// A real HCA moves payloads with dedicated DMA engines that scale past
+// any single CPU core; the emulated backend's equivalent is this
+// process-wide worker pool. Large copies/reduces are split into
+// dynamically-balanced slices executed across the pool (the posting /
+// progress thread participates, so a 1-core machine runs exactly the
+// old inline path with zero extra threads of overhead).
+//
+// Slices are element-disjoint, so parallel reductions are bit-exact
+// with the serial ones regardless of the split.
+
+#include <sched.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace tdr {
+
+namespace {
+
+// Slice granularity: big enough that per-slice dispatch cost vanishes,
+// small enough for dynamic balance across NUMA-variable memcpy speeds.
+constexpr size_t kGrain = 4u << 20;
+
+size_t pool_threads() {
+  const char *env = getenv("TDR_COPY_THREADS");
+  if (env && *env) {
+    long v = atol(env);
+    if (v >= 1) return static_cast<size_t>(std::min(v, 64L));
+  }
+  cpu_set_t set;
+  if (sched_getaffinity(0, sizeof(set), &set) == 0) {
+    int n = CPU_COUNT(&set);
+    if (n >= 1) return static_cast<size_t>(std::min(n, 16));
+  }
+  unsigned hc = std::thread::hardware_concurrency();
+  return hc ? std::min(hc, 16u) : 1;
+}
+
+}  // namespace
+
+bool cma_copy_from(pid_t pid, void *dst, uint64_t src, size_t len) {
+  if (pid == kCmaSameProcess) {
+    memcpy(dst, reinterpret_cast<const void *>(src), len);
+    return true;
+  }
+  char *d = static_cast<char *>(dst);
+  while (len > 0) {
+    iovec liov{d, len};
+    iovec riov{reinterpret_cast<void *>(src), len};
+    ssize_t n = process_vm_readv(pid, &liov, 1, &riov, 1, 0);
+    if (n <= 0) return false;
+    d += n;
+    src += static_cast<uint64_t>(n);
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool cma_copy_to(pid_t pid, uint64_t dst, const void *src, size_t len) {
+  if (pid == kCmaSameProcess) {
+    memcpy(reinterpret_cast<void *>(dst), src, len);
+    return true;
+  }
+  const char *s = static_cast<const char *>(src);
+  while (len > 0) {
+    iovec liov{const_cast<char *>(s), len};
+    iovec riov{reinterpret_cast<void *>(dst), len};
+    ssize_t n = process_vm_writev(pid, &liov, 1, &riov, 1, 0);
+    if (n <= 0) return false;
+    s += n;
+    dst += static_cast<uint64_t>(n);
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+class CopyPool {
+ public:
+  static CopyPool &instance() {
+    // Leaked intentionally: QP progress threads may still be moving
+    // bytes during static destruction; a destructed pool would hang
+    // or crash them. The OS reclaims the threads at exit.
+    static CopyPool *p = new CopyPool(pool_threads());
+    return *p;
+  }
+
+  size_t workers() const { return nthreads_; }
+
+  // Run fn over [0, n) in ~grain-sized slices across the pool; the
+  // calling thread participates. One region at a time — concurrent
+  // callers queue on region_mu_, which is fine because every caller
+  // is itself a full-bandwidth participant.
+  void parfor(size_t n, size_t grain,
+              const std::function<void(size_t, size_t)> &fn) {
+    if (n == 0) return;
+    if (nthreads_ <= 1 || n <= grain) {
+      fn(0, n);
+      return;
+    }
+    std::lock_guard<std::mutex> region(region_mu_);
+    Job job;
+    job.fn = &fn;
+    job.n = n;
+    job.grain = grain;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      job_ = &job;
+    }
+    cv_.notify_all();
+    run_slices(job);
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [&] {
+      return job.active.load(std::memory_order_acquire) == 0;
+    });
+    job_ = nullptr;  // still under mu_: no worker can deref after this
+  }
+
+ private:
+  struct Job {
+    const std::function<void(size_t, size_t)> *fn = nullptr;
+    size_t n = 0;
+    size_t grain = 0;
+    std::atomic<size_t> next{0};
+    std::atomic<int> active{0};
+  };
+
+  explicit CopyPool(size_t nthreads) : nthreads_(nthreads) {
+    for (size_t i = 1; i < nthreads_; i++)
+      threads_.emplace_back([this] { worker(); });
+  }
+
+  static void run_slices(Job &j) {
+    for (;;) {
+      size_t b = j.next.fetch_add(j.grain, std::memory_order_relaxed);
+      if (b >= j.n) break;
+      (*j.fn)(b, std::min(b + j.grain, j.n));
+    }
+  }
+
+  void worker() {
+    for (;;) {
+      Job *j = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] {
+          return job_ && job_->next.load(std::memory_order_relaxed) < job_->n;
+        });
+        j = job_;
+        j->active.fetch_add(1, std::memory_order_acq_rel);
+      }
+      run_slices(*j);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        j->active.fetch_sub(1, std::memory_order_acq_rel);
+      }
+      done_cv_.notify_all();
+    }
+  }
+
+  const size_t nthreads_;
+  std::vector<std::thread> threads_;
+  std::mutex region_mu_;
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  Job *job_ = nullptr;
+};
+
+size_t copy_pool_workers() { return CopyPool::instance().workers(); }
+
+void par_memcpy(void *dst, const void *src, size_t len) {
+  CopyPool::instance().parfor(len, kGrain, [&](size_t b, size_t e) {
+    memcpy(static_cast<char *>(dst) + b,
+           static_cast<const char *>(src) + b, e - b);
+  });
+}
+
+void par_reduce(void *dst, const void *src, size_t n, int dt, int op) {
+  size_t esz = dtype_size(dt);
+  if (esz == 0) return;
+  CopyPool::instance().parfor(n, kGrain / esz, [&](size_t b, size_t e) {
+    reduce_any(static_cast<char *>(dst) + b * esz,
+               static_cast<const char *>(src) + b * esz, e - b, dt, op);
+  });
+}
+
+bool par_cma_copy_from(pid_t pid, void *dst, uint64_t src, size_t len) {
+  if (pid == kCmaSameProcess) {
+    par_memcpy(dst, reinterpret_cast<const void *>(src), len);
+    return true;
+  }
+  std::atomic<bool> ok{true};
+  CopyPool::instance().parfor(len, kGrain, [&](size_t b, size_t e) {
+    if (!cma_copy_from(pid, static_cast<char *>(dst) + b, src + b, e - b))
+      ok.store(false, std::memory_order_relaxed);
+  });
+  return ok.load();
+}
+
+bool par_cma_copy_to(pid_t pid, uint64_t dst, const void *src, size_t len) {
+  if (pid == kCmaSameProcess) {
+    par_memcpy(reinterpret_cast<void *>(dst), src, len);
+    return true;
+  }
+  std::atomic<bool> ok{true};
+  CopyPool::instance().parfor(len, kGrain, [&](size_t b, size_t e) {
+    if (!cma_copy_to(pid, dst + b, static_cast<const char *>(src) + b, e - b))
+      ok.store(false, std::memory_order_relaxed);
+  });
+  return ok.load();
+}
+
+// dst[i] op= peer_mem[i]: same-process folds read the peer buffer in
+// place; cross-process slices stream through per-slice stack windows
+// (cache-resident, so the fold costs one pass of DRAM traffic).
+bool par_cma_reduce_from(pid_t pid, void *dst, uint64_t src, size_t bytes,
+                         int dt, int op) {
+  size_t esz = dtype_size(dt);
+  if (esz == 0 || bytes % esz != 0) return false;
+  if (pid == kCmaSameProcess) {
+    par_reduce(dst, reinterpret_cast<const void *>(src), bytes / esz, dt, op);
+    return true;
+  }
+  std::atomic<bool> ok{true};
+  size_t grain = kGrain - kGrain % esz;
+  CopyPool::instance().parfor(bytes, grain, [&](size_t b, size_t e) {
+    char window[256 << 10];
+    const size_t step = sizeof(window) - sizeof(window) % esz;
+    char *d = static_cast<char *>(dst) + b;
+    uint64_t s = src + b;
+    size_t left = e - b;
+    while (left > 0) {
+      size_t chunk = left < step ? left : step;
+      if (!cma_copy_from(pid, window, s, chunk)) {
+        ok.store(false, std::memory_order_relaxed);
+        return;
+      }
+      reduce_any(d, window, chunk / esz, dt, op);
+      d += chunk;
+      s += chunk;
+      left -= chunk;
+    }
+  });
+  return ok.load();
+}
+
+}  // namespace tdr

@@ -29,6 +29,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <condition_variable>
 #include <cstring>
 #include <deque>
@@ -86,12 +87,36 @@ struct Hello {
   char boot_id[40];
   uint64_t probe_addr;
   uint64_t probe_val;
+  // Random per-process token: the only trustworthy same-process test.
+  // pid comparison is namespace-relative (two containers both have a
+  // "pid 1"), so it is never used to decide the memcpy fast path.
+  uint64_t proc_token;
 };
 struct HelloResult {
   uint8_t cma_ok;
 };
 #pragma pack(pop)
 constexpr uint64_t kHelloMagic = 0x7464725f656d7531ull;  // "tdr_emu1"
+
+uint64_t process_token() {
+  static const uint64_t tok = [] {
+    uint64_t t = 0;
+    int fd = ::open("/dev/urandom", O_RDONLY);
+    if (fd >= 0) {
+      if (::read(fd, &t, sizeof(t)) != sizeof(t)) t = 0;
+      ::close(fd);
+    }
+    if (t == 0) {
+      // Fallback mix: ASLR'd address ^ pid ^ clock.
+      t = reinterpret_cast<uint64_t>(&tok) ^
+          (static_cast<uint64_t>(getpid()) << 32) ^
+          static_cast<uint64_t>(
+              std::chrono::steady_clock::now().time_since_epoch().count());
+    }
+    return t;
+  }();
+  return tok;
+}
 
 std::string read_boot_id() {
   char buf[64] = {0};
@@ -109,44 +134,9 @@ bool cma_disabled() {
   return env && *env && *env != '0';
 }
 
-// One direct copy from (pid, src) into dst. Within a process this is
-// memcpy; across processes it is the kernel's cross-memory-attach —
-// the same single-copy guarantee a loopback DMA gives.
-bool cma_copy_from(pid_t pid, void *dst, uint64_t src, size_t len) {
-  if (pid == getpid()) {
-    memcpy(dst, reinterpret_cast<const void *>(src), len);
-    return true;
-  }
-  char *d = static_cast<char *>(dst);
-  while (len > 0) {
-    iovec liov{d, len};
-    iovec riov{reinterpret_cast<void *>(src), len};
-    ssize_t n = process_vm_readv(pid, &liov, 1, &riov, 1, 0);
-    if (n <= 0) return false;
-    d += n;
-    src += static_cast<uint64_t>(n);
-    len -= static_cast<size_t>(n);
-  }
-  return true;
-}
-
-bool cma_copy_to(pid_t pid, uint64_t dst, const void *src, size_t len) {
-  if (pid == getpid()) {
-    memcpy(reinterpret_cast<void *>(dst), src, len);
-    return true;
-  }
-  const char *s = static_cast<const char *>(src);
-  while (len > 0) {
-    iovec liov{const_cast<char *>(s), len};
-    iovec riov{reinterpret_cast<void *>(dst), len};
-    ssize_t n = process_vm_writev(pid, &liov, 1, &riov, 1, 0);
-    if (n <= 0) return false;
-    s += n;
-    dst += static_cast<uint64_t>(n);
-    len -= static_cast<size_t>(n);
-  }
-  return true;
-}
+// Single-copy moves between address spaces (cma_copy_from/to) live in
+// copy_pool.cc along with the pool-parallel wrappers used below — the
+// emulated analogue of an HCA's parallel DMA engines.
 
 class EmuEngine;
 
@@ -452,9 +442,9 @@ class EmuQp : public Qp {
       return;
     }
     if (r.is_reduce)
-      reduce_any(r.dst, data, len / dtype_size(r.dtype), r.dtype, r.red_op);
+      par_reduce(r.dst, data, len / dtype_size(r.dtype), r.dtype, r.red_op);
     else
-      memcpy(r.dst, data, len);
+      par_memcpy(r.dst, data, len);
     push_wc({r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, len});
   }
 
@@ -498,31 +488,10 @@ class EmuQp : public Qp {
       return true;  // desc mode: nothing on the wire to drain
     }
     bool ok;
-    if (!r.is_reduce) {
-      ok = cma_copy_from(peer_pid_, r.dst, src, len);
-    } else if (peer_pid_ == getpid()) {
-      reduce_any(r.dst, reinterpret_cast<const void *>(src),
-                 len / dtype_size(r.dtype), r.dtype, r.red_op);
-      ok = true;
-    } else {
-      const size_t esz = dtype_size(r.dtype);
-      char window[256 << 10];
-      const size_t step = sizeof(window) - sizeof(window) % esz;
-      char *dst = r.dst;
-      uint64_t left = len;
-      ok = true;
-      while (left > 0) {
-        size_t chunk = left < step ? static_cast<size_t>(left) : step;
-        if (!cma_copy_from(peer_pid_, window, src, chunk)) {
-          ok = false;
-          break;
-        }
-        reduce_any(dst, window, chunk / esz, r.dtype, r.red_op);
-        dst += chunk;
-        src += chunk;
-        left -= chunk;
-      }
-    }
+    if (!r.is_reduce)
+      ok = par_cma_copy_from(peer_pid_, r.dst, src, len);
+    else
+      ok = par_cma_reduce_from(peer_pid_, r.dst, src, len, r.dtype, r.red_op);
     push_wc({r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
              TDR_OP_RECV, len});
     return ok;
@@ -538,13 +507,14 @@ class EmuQp : public Qp {
     probe_val_ = kHelloMagic ^ reinterpret_cast<uint64_t>(this);
     Hello mine{};
     mine.magic = kHelloMagic;
-    mine.version = 2;
+    mine.version = 3;
     mine.pid = getpid();
     mine.uid = getuid();
     std::string boot = read_boot_id();
     strncpy(mine.boot_id, boot.c_str(), sizeof(mine.boot_id) - 1);
     mine.probe_addr = reinterpret_cast<uint64_t>(&probe_val_);
     mine.probe_val = probe_val_;
+    mine.proc_token = process_token();
 
     Hello peer{};
     if (!write_full(fd_, &mine, sizeof(mine)) ||
@@ -559,13 +529,19 @@ class EmuQp : public Qp {
       return;
     }
 
-    peer_pid_ = peer.pid;
+    // Same process is decided by the random token, never by pid (pids
+    // are namespace-relative). An unreadable boot_id fails CLOSED:
+    // "can't prove same host" must not become "assume same host".
+    bool same_process =
+        peer.proc_token == process_token() && peer.pid == getpid();
+    peer_pid_ = same_process ? kCmaSameProcess : peer.pid;
     bool same_host =
+        boot[0] != '\0' &&
         strncmp(mine.boot_id, peer.boot_id, sizeof(mine.boot_id)) == 0;
     uint8_t my_ok = 0;
-    if (same_host && !cma_disabled()) {
+    if ((same_process || same_host) && !cma_disabled()) {
       uint64_t got = 0;
-      if (cma_copy_from(peer.pid, &got, peer.probe_addr, sizeof(got)) &&
+      if (cma_copy_from(peer_pid_, &got, peer.probe_addr, sizeof(got)) &&
           got == peer.probe_val)
         my_ok = 1;
     }
@@ -612,6 +588,69 @@ class EmuQp : public Qp {
     std::lock_guard<std::mutex> g(mu_);
     cq_.push_back(wc);
     cv_.notify_all();
+  }
+
+  // Shared OP_SEND / OP_SEND_DESC skeleton: match the inbound message
+  // to a posted recv, else bounce-buffer the payload and re-check (a
+  // recv may have been posted while the payload was being fetched —
+  // it saw unexpected_ empty and queued itself; deliver rather than
+  // strand it). Returns the ack status; sets *dead on connection loss
+  // (stream-tier fetch/land failures are connection loss; CMA-tier
+  // failures are reportable errors).
+  uint8_t handle_send_inbound(const FrameHdr &h, bool desc, bool *dead) {
+    *dead = false;
+    PostedRecv r{};
+    bool have = false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (!recvs_.empty()) {
+        r = recvs_.front();
+        recvs_.pop_front();
+        have = true;
+      }
+    }
+    if (have) {
+      if (desc)
+        return land_cma(r, h.aux, h.len) ? TDR_WC_SUCCESS
+                                         : TDR_WC_GENERAL_ERR;
+      if (!land_stream(r, h.len)) *dead = true;
+      return TDR_WC_SUCCESS;
+    }
+    // Unexpected message: materialize it now. In desc mode the
+    // sender's buffer is only promised stable until its completion,
+    // which our ack produces — so the copy must happen before the ack.
+    std::vector<char> buf(h.len);
+    bool ok;
+    if (desc) {
+      ok = h.len == 0 ||
+           par_cma_copy_from(peer_pid_, buf.data(), h.aux, h.len);
+    } else {
+      if (h.len && !read_full(fd_, buf.data(), h.len)) {
+        *dead = true;
+        return 0;
+      }
+      ok = true;
+    }
+    if (!ok) buf.clear();
+    PostedRecv r2{};
+    bool have2 = false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (!recvs_.empty()) {
+        r2 = recvs_.front();
+        recvs_.pop_front();
+        have2 = true;
+      } else if (ok) {
+        unexpected_.push_back(std::move(buf));
+      }
+    }
+    if (have2) {
+      if (ok)
+        deliver_buffer(r2, buf.data(), buf.size());
+      else
+        push_wc({r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
+    }
+    return ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
   }
 
   // Drain len payload bytes we cannot place (bad rkey etc.).
@@ -669,46 +708,19 @@ class EmuQp : public Qp {
           break;
         }
         case OP_SEND: {
-          PostedRecv r{};
-          bool have = false;
-          {
-            std::lock_guard<std::mutex> g(mu_);
-            if (!recvs_.empty()) {
-              r = recvs_.front();
-              recvs_.pop_front();
-              have = true;
-            }
-          }
+          bool dead = false;
           FrameHdr ack{};
           ack.op = OP_SEND_ACK;
           ack.seq = h.seq;
-          ack.status = TDR_WC_SUCCESS;
-          if (have) {
-            if (!land_stream(r, h.len)) goto out;
-          } else {
-            std::vector<char> buf(h.len);
-            if (h.len && !read_full(fd_, buf.data(), h.len)) goto out;
-            // Re-check under the lock: a recv may have been posted
-            // while we were reading the payload (it saw unexpected_
-            // empty and queued itself); deliver rather than strand it.
-            PostedRecv r2{};
-            bool have2 = false;
-            {
-              std::lock_guard<std::mutex> g(mu_);
-              if (!recvs_.empty()) {
-                r2 = recvs_.front();
-                recvs_.pop_front();
-                have2 = true;
-              } else {
-                unexpected_.push_back(std::move(buf));
-              }
-            }
-            if (have2) deliver_buffer(r2, buf.data(), buf.size());
-          }
+          ack.status = handle_send_inbound(h, /*desc=*/false, &dead);
+          if (dead) goto out;
           if (!send_frame(ack, nullptr, 0)) goto out;
           break;
         }
         case OP_WRITE_DESC: {
+          // Desc ops are only valid after both sides negotiated the
+          // CMA tier; peer_pid_ is meaningless otherwise.
+          if (!cma_) goto out;
           EmuMr *tmr = nullptr;
           char *dst = eng_->resolve(h.rkey, h.raddr, h.len,
                                     TDR_ACCESS_REMOTE_WRITE, &tmr);
@@ -716,7 +728,7 @@ class EmuQp : public Qp {
           ack.op = OP_WRITE_ACK;
           ack.seq = h.seq;
           if (dst) {
-            bool ok = cma_copy_from(peer_pid_, dst, h.aux, h.len);
+            bool ok = par_cma_copy_from(peer_pid_, dst, h.aux, h.len);
             EmuEngine::dma_done(tmr);
             ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
           } else {
@@ -726,6 +738,7 @@ class EmuQp : public Qp {
           break;
         }
         case OP_READ_REQ_DESC: {
+          if (!cma_) goto out;
           EmuMr *tmr = nullptr;
           char *src = eng_->resolve(h.rkey, h.raddr, h.len,
                                     TDR_ACCESS_REMOTE_READ, &tmr);
@@ -734,7 +747,7 @@ class EmuQp : public Qp {
           resp.seq = h.seq;
           resp.len = 0;  // bytes moved via CMA, none follow on the wire
           if (src) {
-            bool ok = cma_copy_to(peer_pid_, h.aux, src, h.len);
+            bool ok = par_cma_copy_to(peer_pid_, h.aux, src, h.len);
             EmuEngine::dma_done(tmr);
             resp.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
           } else {
@@ -744,50 +757,13 @@ class EmuQp : public Qp {
           break;
         }
         case OP_SEND_DESC: {
-          PostedRecv r{};
-          bool have = false;
-          {
-            std::lock_guard<std::mutex> g(mu_);
-            if (!recvs_.empty()) {
-              r = recvs_.front();
-              recvs_.pop_front();
-              have = true;
-            }
-          }
+          if (!cma_) goto out;
+          bool dead = false;
           FrameHdr ack{};
           ack.op = OP_SEND_ACK;
           ack.seq = h.seq;
-          ack.status = TDR_WC_SUCCESS;
-          if (have) {
-            if (!land_cma(r, h.aux, h.len)) ack.status = TDR_WC_GENERAL_ERR;
-          } else {
-            // Unexpected message: land it in a bounce buffer now (the
-            // sender's buffer is only promised stable until its
-            // completion, which this ack produces).
-            std::vector<char> buf(h.len);
-            bool ok = h.len == 0 ||
-                      cma_copy_from(peer_pid_, buf.data(), h.aux, h.len);
-            if (!ok) buf.clear();
-            PostedRecv r2{};
-            bool have2 = false;
-            {
-              std::lock_guard<std::mutex> g(mu_);
-              if (!recvs_.empty()) {
-                r2 = recvs_.front();
-                recvs_.pop_front();
-                have2 = true;
-              } else if (ok) {
-                unexpected_.push_back(std::move(buf));
-              }
-            }
-            if (have2) {
-              if (ok)
-                deliver_buffer(r2, buf.data(), buf.size());
-              else
-                push_wc({r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
-            }
-            if (!ok) ack.status = TDR_WC_GENERAL_ERR;
-          }
+          ack.status = handle_send_inbound(h, /*desc=*/true, &dead);
+          if (dead) goto out;
           if (!send_frame(ack, nullptr, 0)) goto out;
           break;
         }

@@ -27,6 +27,11 @@ namespace {
 
 constexpr size_t kDefaultChunk = 8u << 20;
 constexpr int kWindow = 4;  // pre-posted recv slots per step
+// Cap on work requests in flight per direction, below the verbs
+// backend's QP depth (max_send_wr/max_recv_wr = 512) with headroom —
+// tiny TDR_RING_CHUNK values otherwise overflow ibv_post_* on large
+// segments (the emu backend's unbounded queues would hide that).
+constexpr size_t kMaxOutstanding = 256;
 
 size_t ring_chunk_bytes() {
   const char *env = getenv("TDR_RING_CHUNK");
@@ -212,10 +217,12 @@ struct StepPipe {
     };
 
     // Receives without a slot dependency (phase 2, and fused phase 1 —
-    // disjoint folds straight into the data MR) are pre-posted in
-    // full so inbound chunks always have a landing target. Windowed
-    // phase-1 receives pre-post up to the scratch window.
-    size_t prepost = windowed ? std::min(n_recv, slots) : n_recv;
+    // disjoint folds straight into the data MR) are pre-posted deep so
+    // inbound chunks always have a landing target; windowed phase-1
+    // receives pre-post up to the scratch window. Both bounded by the
+    // QP depth — drain() reposts as completions retire.
+    size_t prepost = windowed ? std::min(n_recv, slots)
+                              : std::min(n_recv, kMaxOutstanding);
     for (; posted_r < prepost; posted_r++)
       if (post_recv_chunk(posted_r) != 0) return -1;
 
@@ -243,9 +250,9 @@ struct StepPipe {
           }
           if (windowed) {
             size_t len = chunk_len(recv_len, idx);
-            reduce_any(cdata + recv_off + idx * chunk,
-                       r->tmp.data() + (idx % slots) * slot_bytes, len / esz,
-                       dtype, red_op);
+            tdr::par_reduce(cdata + recv_off + idx * chunk,
+                            r->tmp.data() + (idx % slots) * slot_bytes,
+                            len / esz, dtype, red_op);
           }
           done_r++;
           if (posted_r < n_recv) {
@@ -266,6 +273,7 @@ struct StepPipe {
       // peer's posted recvs; racing ahead would push inbound messages
       // onto the unexpected (bounce-buffer) path and double-copy them.
       bool may_send = posted_s < n_send &&
+                      posted_s - acked_s < kMaxOutstanding &&
                       (!windowed || n_recv == 0 || posted_s < done_r + slots);
       if (may_send) {
         size_t len = chunk_len(send_len, posted_s);

@@ -69,22 +69,34 @@ void reduce_typed(T *dst, const T *src, size_t n, int op) {
   }
 }
 
-void reduce_bf16(uint16_t *dst, const uint16_t *src, size_t n, int op) {
+// Op as a template parameter keeps the inner loop branch-free so the
+// compiler can vectorize the convert-accumulate-convert pipeline.
+template <int kOp>
+void reduce_bf16_op(uint16_t *dst, const uint16_t *src, size_t n) {
   for (size_t i = 0; i < n; i++) {
     float a = bf16_to_f32(dst[i]), b = bf16_to_f32(src[i]);
-    float r = a;
-    switch (op) {
-      case TDR_RED_SUM:
-        r = a + b;
-        break;
-      case TDR_RED_MAX:
-        r = b > a ? b : a;
-        break;
-      case TDR_RED_MIN:
-        r = b < a ? b : a;
-        break;
-    }
+    float r;
+    if (kOp == TDR_RED_SUM)
+      r = a + b;
+    else if (kOp == TDR_RED_MAX)
+      r = b > a ? b : a;
+    else
+      r = b < a ? b : a;
     dst[i] = f32_to_bf16(r);
+  }
+}
+
+void reduce_bf16(uint16_t *dst, const uint16_t *src, size_t n, int op) {
+  switch (op) {
+    case TDR_RED_SUM:
+      reduce_bf16_op<TDR_RED_SUM>(dst, src, n);
+      break;
+    case TDR_RED_MAX:
+      reduce_bf16_op<TDR_RED_MAX>(dst, src, n);
+      break;
+    case TDR_RED_MIN:
+      reduce_bf16_op<TDR_RED_MIN>(dst, src, n);
+      break;
   }
 }
 

@@ -93,6 +93,7 @@ def _load() -> ctypes.CDLL:
 def _declare(lib: ctypes.CDLL) -> None:
     P = ctypes.c_void_p
     lib.tdr_last_error.restype = ctypes.c_char_p
+    lib.tdr_copy_pool_workers.restype = ctypes.c_size_t
     lib.tdr_engine_open.restype = P
     lib.tdr_engine_open.argtypes = [ctypes.c_char_p]
     lib.tdr_engine_close.argtypes = [P]
@@ -146,6 +147,12 @@ def _declare(lib: ctypes.CDLL) -> None:
 
 class TransportError(RuntimeError):
     pass
+
+
+def copy_pool_workers() -> int:
+    """Worker count of the native parallel copy/reduce pool (the
+    emulated NIC's DMA-engine array; TDR_COPY_THREADS overrides)."""
+    return int(_load().tdr_copy_pool_workers())
 
 
 def _check(cond, what: str):
