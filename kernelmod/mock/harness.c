@@ -1,0 +1,309 @@
+/* SPDX-License-Identifier: MIT */
+/*
+ * Mock-kernel harness: drives the UNMODIFIED tpup2p.c / tpup2ptest.c
+ * module code through the full peer-memory lifecycle in a plain
+ * process.
+ *
+ * Coverage mirrors SURVEY.md §3's call stacks, which the reference
+ * could only exercise on real Fiji+ConnectX hardware:
+ *   §3.1 module load/registration      → constructors + mock ib_core
+ *   §3.2 ibv_reg_mr claim→pin→map      → claim ioctl + client ops calls
+ *   §3.4 free-while-registered revoke  → mock_dmabuf_move → move_notify
+ *   §3.5 deregistration                → dma_unmap/put_pages/release
+ *   §3.6 chardev harness + mmap        → tpup2ptest ioctls + fops->mmap
+ * plus the leak/refcount invariants (module refs, dma-buf refs,
+ * attachment and mapping balance, kzalloc balance) that only crash a
+ * real kernel long after the bug.
+ */
+
+#include <mock/mock_kernel.h>
+
+#include "../tpup2p/peer_mem_compat.h"
+#include "../tpup2p/tpup2p_uapi.h"
+#include "../tpup2ptest/tpup2ptest_uapi.h"
+
+static int failures;
+
+#define CHECK(cond)                                                       \
+	do {                                                              \
+		if (!(cond)) {                                            \
+			fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__,     \
+				__LINE__, #cond);                         \
+			failures++;                                       \
+		}                                                         \
+	} while (0)
+
+#define CHECK_EQ(a, b)                                                     \
+	do {                                                               \
+		long long va_ = (long long)(a), vb_ = (long long)(b);      \
+		if (va_ != vb_) {                                          \
+			fprintf(stderr,                                    \
+				"FAIL %s:%d: %s == %lld, want %s == %lld\n", \
+				__FILE__, __LINE__, #a, va_, #b, vb_);     \
+			failures++;                                        \
+		}                                                          \
+	} while (0)
+
+enum { BUF_SIZE = 16 * 4096 };
+static const u64 kVa = 0x500000000000ull;
+
+/* §3.2: the ib_core side of ibv_reg_mr, replayed against the client
+ * ops exactly as OFED drives them. Returns the client context. */
+static void *do_register(const struct peer_memory_client *pc, u64 va,
+			 size_t len, u64 core_context, struct sg_table *sg,
+			 int *nmap)
+{
+	void *ctx = NULL;
+	static struct device nic_dev = { "mock-hca" };
+	int ret = pc->acquire((unsigned long)va, len, NULL, NULL, &ctx);
+
+	CHECK_EQ(ret, 1);
+	CHECK(ctx != NULL);
+	ret = pc->get_pages((unsigned long)va, len, 1, 0, sg, ctx,
+			    core_context);
+	CHECK_EQ(ret, 0);
+	CHECK_EQ(pc->get_page_size(ctx), PAGE_SIZE);
+	ret = pc->dma_map(sg, ctx, &nic_dev, 0, nmap);
+	CHECK_EQ(ret, 0);
+	return ctx;
+}
+
+static void do_deregister(const struct peer_memory_client *pc, void *ctx,
+			  struct sg_table *sg)
+{
+	static struct device nic_dev = { "mock-hca" };
+
+	pc->dma_unmap(sg, ctx, &nic_dev);
+	pc->put_pages(sg, ctx);
+	pc->release(ctx);
+}
+
+static void test_bridge_lifecycle(struct file *bridge, int fd)
+{
+	const struct peer_memory_client *pc = mock_peer_client();
+	struct tpup2p_claim_param cp = { kVa, BUF_SIZE, fd, 0, 0 };
+	struct sg_table sg;
+	int nmap = 0;
+	void *ctx;
+	char *mem;
+
+	CHECK(pc != NULL);
+	CHECK_EQ(mock_dev_ioctl(bridge, TPUP2P_IOC_CLAIM, &cp), 0);
+
+	/* overlapping claim rejected */
+	struct tpup2p_claim_param overlap = { kVa + 4096, 4096, fd, 0, 0 };
+	CHECK_EQ(mock_dev_ioctl(bridge, TPUP2P_IOC_CLAIM, &overlap), -EEXIST);
+
+	/* bad fd propagates the dma_buf_get error */
+	struct tpup2p_claim_param badfd = { kVa + (64u << 20), 4096, 9999, 0,
+					    0 };
+	CHECK_EQ(mock_dev_ioctl(bridge, TPUP2P_IOC_CLAIM, &badfd), -EBADF);
+
+	/* unclaimed VA is "not ours" (acquire → 0, amdp2p.c:133-136) */
+	void *nctx = (void *)0xdead;
+	CHECK_EQ(pc->acquire(0x1000, 4096, NULL, NULL, &nctx), 0);
+
+	/* another process's VA is not ours either (tgid scoping) */
+	mock_set_tgid(1);
+	CHECK_EQ(pc->acquire((unsigned long)kVa, BUF_SIZE, NULL, NULL, &nctx),
+		 0);
+	mock_set_tgid(0);
+
+	/* alloc failure → claim refused, not an error (amdp2p.c:140-144) */
+	mock_fail_next_kzalloc = 1;
+	CHECK_EQ(pc->acquire((unsigned long)kVa, BUF_SIZE, NULL, NULL, &nctx),
+		 0);
+
+	/* the real registration */
+	int refs0 = mock_module_refs;
+	ctx = do_register(pc, kVa, BUF_SIZE, 42, &sg, &nmap);
+	CHECK_EQ(mock_module_refs, refs0 + 1);
+	CHECK_EQ(nmap, BUF_SIZE / PAGE_SIZE);
+	CHECK_EQ(sg.nents, BUF_SIZE / PAGE_SIZE);
+
+	/* bus addresses really back the dma-buf: write through the sg
+	 * list, read via the exporter's memory */
+	mem = mock_dmabuf_mem(fd);
+	for (unsigned int i = 0; i < sg.nents; i++) {
+		char *bus = (char *)(uintptr_t)sg_dma_address(&sg.sgl[i]);
+
+		CHECK(bus == mem + (size_t)i * PAGE_SIZE);
+		memset(bus, 0x30 + (int)(i % 10), sg_dma_len(&sg.sgl[i]));
+	}
+	CHECK_EQ(mem[0], 0x30);
+	CHECK_EQ(mem[PAGE_SIZE], 0x31);
+
+	/* clean §3.5 teardown */
+	do_deregister(pc, ctx, &sg);
+	CHECK_EQ(mock_module_refs, refs0);
+	CHECK_EQ(mock_dmabuf_live_mappings(), 0);
+	CHECK_EQ(mock_dmabuf_live_attachments(), 0);
+
+	/* §3.4 revocation: exporter moves the buffer while registered */
+	int inv0 = mock_invalidate_count();
+	ctx = do_register(pc, kVa, BUF_SIZE, 43, &sg, &nmap);
+	mock_dmabuf_move(fd);
+	CHECK_EQ(mock_invalidate_count(), inv0 + 1);
+	CHECK_EQ(mock_last_invalidated_core_context(), 43);
+	CHECK_EQ(mock_dmabuf_live_mappings(), 0); /* move tore the map down */
+	/* ib_core still runs the dereg path afterwards; it must not
+	 * double-unmap (the amdp2p.c:299-302 guard) and must still drop
+	 * the attachment */
+	do_deregister(pc, ctx, &sg);
+	CHECK_EQ(mock_dmabuf_live_attachments(), 0);
+	CHECK_EQ(mock_module_refs, refs0);
+
+	/* unclaim; then the range is nobody's */
+	struct tpup2p_unclaim_param up = { kVa };
+	CHECK_EQ(mock_dev_ioctl(bridge, TPUP2P_IOC_UNCLAIM, &up), 0);
+	CHECK_EQ(mock_dev_ioctl(bridge, TPUP2P_IOC_UNCLAIM, &up), -ENOENT);
+	CHECK_EQ(pc->acquire((unsigned long)kVa, BUF_SIZE, NULL, NULL, &nctx),
+		 0);
+}
+
+static void test_chardev_harness(struct file *bridge, int fd)
+{
+	struct file *tf = mock_dev_open(TPUP2PTEST_DEV_PATH + 5);
+	struct tpup2p_claim_param cp = { kVa, BUF_SIZE, fd, 0, 0 };
+
+	CHECK(tf != NULL);
+	CHECK_EQ(mock_dev_ioctl(bridge, TPUP2P_IOC_CLAIM, &cp), 0);
+
+	/* QUERY: claimed vs unclaimed (§3.6 is_gpu_address analogue) */
+	struct tpup2ptest_query_param q = { kVa, BUF_SIZE, 0, 0 };
+	CHECK_EQ(mock_dev_ioctl(tf, TPUP2PTEST_IOC_QUERY, &q), 0);
+	CHECK_EQ(q.is_device, 1);
+	q = (struct tpup2ptest_query_param){ 0x1000, 4096, 7, 0 };
+	CHECK_EQ(mock_dev_ioctl(tf, TPUP2PTEST_IOC_QUERY, &q), 0);
+	CHECK_EQ(q.is_device, 0);
+
+	/* PAGE_SIZE */
+	struct tpup2ptest_page_size_param ps = { kVa, 0 };
+	CHECK_EQ(mock_dev_ioctl(tf, TPUP2PTEST_IOC_PAGE_SIZE, &ps), 0);
+	CHECK_EQ(ps.page_size, PAGE_SIZE);
+
+	/* PIN; and a second pin of the same range must coexist (the
+	 * double-get_pages semantics the reference made testable,
+	 * tests/amdp2ptest.c:296-299 — here unambiguous via handles) */
+	struct tpup2ptest_pin_param p1 = { kVa, BUF_SIZE, 0, 0 };
+	struct tpup2ptest_pin_param p2 = { kVa, BUF_SIZE, 0, 0 };
+	CHECK_EQ(mock_dev_ioctl(tf, TPUP2PTEST_IOC_PIN, &p1), 0);
+	CHECK_EQ(mock_dev_ioctl(tf, TPUP2PTEST_IOC_PIN, &p2), 0);
+	CHECK_EQ(p1.nents, BUF_SIZE / PAGE_SIZE);
+	CHECK(p1.handle != p2.handle);
+	CHECK_EQ(mock_dmabuf_live_mappings(), 2);
+
+	/* pin of an unclaimed range */
+	struct tpup2ptest_pin_param pbad = { 0x2000, 4096, 0, 0 };
+	CHECK_EQ(mock_dev_ioctl(tf, TPUP2PTEST_IOC_PIN, &pbad), -ENXIO);
+
+	/* mmap walks the WHOLE sg list (the reference bug mapped only
+	 * the first entry, tests/amdp2ptest.c:389) */
+	struct vm_area_struct vma = { 0x10000000,
+				      0x10000000 + BUF_SIZE,
+				      (unsigned long)p1.handle, 0 };
+	mock_mmap_reset();
+	CHECK_EQ(tf->f_op->mmap((struct file *)tf, &vma), 0);
+	CHECK_EQ(mock_mmap_segment_count(), BUF_SIZE / PAGE_SIZE);
+	unsigned long covered = 0;
+	unsigned long expect_uaddr = vma.vm_start;
+	char *mem = mock_dmabuf_mem(fd);
+	for (int i = 0; i < mock_mmap_segment_count(); i++) {
+		const struct mock_map_segment *s = mock_mmap_segment(i);
+
+		CHECK_EQ(s->uaddr, expect_uaddr);
+		CHECK_EQ(s->pfn,
+			 ((unsigned long)(uintptr_t)mem +
+			  (unsigned long)i * PAGE_SIZE) >> PAGE_SHIFT);
+		expect_uaddr += s->size;
+		covered += s->size;
+	}
+	CHECK_EQ(covered, BUF_SIZE);
+
+	/* partial mmap clamps to the vma */
+	struct vm_area_struct small = { 0x20000000, 0x20000000 + 2 * PAGE_SIZE,
+					(unsigned long)p2.handle, 0 };
+	mock_mmap_reset();
+	CHECK_EQ(tf->f_op->mmap((struct file *)tf, &small), 0);
+	covered = 0;
+	for (int i = 0; i < mock_mmap_segment_count(); i++)
+		covered += mock_mmap_segment(i)->size;
+	CHECK_EQ(covered, 2 * PAGE_SIZE);
+
+	/* mmap of an unknown handle */
+	struct vm_area_struct bad = { 0x30000000, 0x30001000, 77, 0 };
+	CHECK_EQ(tf->f_op->mmap((struct file *)tf, &bad), -ENXIO);
+
+	/* UNPIN once; a second unpin of the same handle fails */
+	struct tpup2ptest_unpin_param u = { p1.handle };
+	CHECK_EQ(mock_dev_ioctl(tf, TPUP2PTEST_IOC_UNPIN, &u), 0);
+	CHECK_EQ(mock_dev_ioctl(tf, TPUP2PTEST_IOC_UNPIN, &u), -ENOENT);
+	CHECK_EQ(mock_dmabuf_live_mappings(), 1);
+
+	/* close with p2 still pinned: cleanup-on-close reclaims it
+	 * (tests/amdp2ptest.c:115-139 contract) */
+	CHECK_EQ(mock_dev_close(tf), 0);
+	CHECK_EQ(mock_dmabuf_live_mappings(), 0);
+	CHECK_EQ(mock_dmabuf_live_attachments(), 0);
+
+	struct tpup2p_unclaim_param up = { kVa };
+	CHECK_EQ(mock_dev_ioctl(bridge, TPUP2P_IOC_UNCLAIM, &up), 0);
+}
+
+static void test_claims_die_with_fd(int fd)
+{
+	struct file *bridge = mock_dev_open("tpup2p");
+	struct tpup2p_claim_param cp = { kVa, BUF_SIZE, fd, 0, 0 };
+	const struct peer_memory_client *pc = mock_peer_client();
+	void *nctx;
+
+	CHECK(bridge != NULL);
+	CHECK_EQ(mock_dev_ioctl(bridge, TPUP2P_IOC_CLAIM, &cp), 0);
+	/* leak the claim; close must reap it */
+	CHECK_EQ(mock_dev_close(bridge), 0);
+	CHECK_EQ(pc->acquire((unsigned long)kVa, BUF_SIZE, NULL, NULL, &nctx),
+		 0);
+}
+
+int main(void)
+{
+	struct file *bridge;
+	int fd;
+
+	/* module_init constructors already ran: both devices exist and
+	 * the peer-memory client is registered (§3.1). */
+	CHECK(mock_misc_find("tpup2p") != NULL);
+	CHECK(mock_misc_find("tpup2ptest") != NULL);
+	CHECK(mock_peer_client() != NULL);
+
+	bridge = mock_dev_open("tpup2p");
+	CHECK(bridge != NULL);
+	fd = mock_dmabuf_create(BUF_SIZE);
+	CHECK(fd > 0);
+
+	test_bridge_lifecycle(bridge, fd);
+	test_chardev_harness(bridge, fd);
+	CHECK_EQ(mock_dev_close(bridge), 0);
+	test_claims_die_with_fd(fd);
+
+	/* module exit: devices unregister, stray claims reaped */
+	mock_run_module_exits();
+	CHECK(mock_misc_find("tpup2p") == NULL);
+	CHECK(mock_misc_find("tpup2ptest") == NULL);
+	CHECK(mock_peer_client() == NULL);
+
+	/* global leak invariants */
+	mock_dmabuf_fd_close(fd);
+	CHECK_EQ(mock_dmabuf_live_bufs(), 0);
+	CHECK_EQ(mock_dmabuf_live_attachments(), 0);
+	CHECK_EQ(mock_dmabuf_live_mappings(), 0);
+	CHECK_EQ(mock_module_refs, 0);
+	CHECK_EQ(mock_kzalloc_live, 0);
+
+	if (failures) {
+		fprintf(stderr, "HARNESS FAIL: %d check(s)\n", failures);
+		return 1;
+	}
+	printf("MOCK-KERNEL HARNESS PASS\n");
+	return 0;
+}

@@ -1,0 +1,3 @@
+/* SPDX-License-Identifier: MIT */
+/* mock stub — see mock/mock_kernel.h */
+#include <mock/mock_kernel.h>

@@ -1,0 +1,395 @@
+/* SPDX-License-Identifier: MIT */
+/*
+ * Userspace mock of the kernel APIs consumed by tpup2p/tpup2ptest.
+ *
+ * SURVEY.md §4's central lesson is that the reference's kernel code was
+ * untestable without a Fiji GPU + ConnectX HCA; this mock closes that
+ * gap for our kernel modules: the UNMODIFIED module sources compile
+ * against these headers into an ordinary process, where a harness
+ * (harness.c) drives the full claim → acquire → pin → map → revoke →
+ * teardown lifecycle and asserts on leak counters the real kernel
+ * would only reveal as crashes.
+ *
+ * Only the symbols the two modules actually use are provided. Where
+ * kernel semantics matter to the code under test (ERR_PTR encoding,
+ * dma-buf refcounts and move_notify, per-fd release, idr identity,
+ * copy_{from,to}_user failure paths, kzalloc failure injection) the
+ * mock honors them; everything else is the simplest thing that links.
+ */
+#ifndef MOCK_KERNEL_H
+#define MOCK_KERNEL_H
+
+#include <errno.h>
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdbool.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#ifdef __cplusplus
+#error "mock kernel headers are C only (kernel modules are C)"
+#endif
+
+/* ------------------------------------------------------------------ *
+ * types
+ * ------------------------------------------------------------------ */
+typedef uint8_t u8;
+typedef uint16_t u16;
+typedef uint32_t u32;
+/* unsigned long long, as in the kernel, so %llu/%llx formats match */
+typedef unsigned long long u64;
+typedef int32_t s32;
+typedef long long s64;
+typedef unsigned long long __u64;
+typedef uint32_t __u32;
+typedef int32_t __s32;
+typedef unsigned int gfp_t;
+typedef unsigned long pgprot_t;
+
+#define GFP_KERNEL 0u
+#define __user
+#define __init
+#define __exit
+
+#define PAGE_SHIFT 12
+#define PAGE_SIZE (1UL << PAGE_SHIFT)
+
+#ifndef offsetof
+#define offsetof(type, member) __builtin_offsetof(type, member)
+#endif
+#define container_of(ptr, type, member) \
+	((type *)((char *)(ptr) - offsetof(type, member)))
+
+#define min(a, b) ((a) < (b) ? (a) : (b))
+
+/* ------------------------------------------------------------------ *
+ * printk
+ * ------------------------------------------------------------------ */
+void mock_log(const char *lvl, const char *fmt, ...)
+	__attribute__((format(printf, 2, 3)));
+#define pr_debug(...) mock_log("debug", __VA_ARGS__)
+#define pr_info(...) mock_log("info", __VA_ARGS__)
+#define pr_warn(...) mock_log("warn", __VA_ARGS__)
+#define pr_err(...) mock_log("err", __VA_ARGS__)
+
+/* ------------------------------------------------------------------ *
+ * ERR_PTR
+ * ------------------------------------------------------------------ */
+static inline void *ERR_PTR(long error) { return (void *)error; }
+static inline long PTR_ERR(const void *ptr) { return (long)ptr; }
+static inline bool IS_ERR(const void *ptr)
+{
+	return (unsigned long)ptr >= (unsigned long)-4095;
+}
+
+/* ------------------------------------------------------------------ *
+ * slab — with a live-allocation counter and failure injection so the
+ * harness can assert leak-freedom and exercise alloc-failure paths
+ * (the reference treats kzalloc failure in acquire as "not mine",
+ * amdp2p.c:140-144; tpup2p keeps that contract).
+ * ------------------------------------------------------------------ */
+extern int mock_kzalloc_live;
+extern int mock_fail_next_kzalloc;
+void *mock_kzalloc(size_t n);
+void mock_kfree(void *p);
+#define kzalloc(n, flags) mock_kzalloc(n)
+#define kfree(p) mock_kfree(p)
+
+/* ------------------------------------------------------------------ *
+ * mutex
+ * ------------------------------------------------------------------ */
+struct mutex {
+	pthread_mutex_t m;
+};
+#define DEFINE_MUTEX(name) struct mutex name = { PTHREAD_MUTEX_INITIALIZER }
+static inline void mutex_init(struct mutex *mu)
+{
+	pthread_mutex_init(&mu->m, NULL);
+}
+static inline void mutex_lock(struct mutex *mu)
+{
+	pthread_mutex_lock(&mu->m);
+}
+static inline void mutex_unlock(struct mutex *mu)
+{
+	pthread_mutex_unlock(&mu->m);
+}
+
+/* ------------------------------------------------------------------ *
+ * current / pids — harness can impersonate another process to test
+ * the tgid scoping of the claim table.
+ * ------------------------------------------------------------------ */
+#define current ((void *)0)
+pid_t mock_task_tgid_nr(void);
+void mock_set_tgid(pid_t tgid); /* 0 = real getpid() */
+#define task_tgid_nr(task) mock_task_tgid_nr()
+
+/* ------------------------------------------------------------------ *
+ * rbtree — same API, plain BST internals (balance is a perf property
+ * the code under test never observes)
+ * ------------------------------------------------------------------ */
+struct rb_node {
+	struct rb_node *rb_left;
+	struct rb_node *rb_right;
+	struct rb_node *rb_parent;
+};
+struct rb_root {
+	struct rb_node *rb_node;
+};
+#define RB_ROOT ((struct rb_root){ NULL })
+#define rb_entry(ptr, type, member) container_of(ptr, type, member)
+
+static inline void rb_link_node(struct rb_node *node, struct rb_node *parent,
+				struct rb_node **rb_link)
+{
+	node->rb_left = NULL;
+	node->rb_right = NULL;
+	node->rb_parent = parent;
+	*rb_link = node;
+}
+static inline void rb_insert_color(struct rb_node *node, struct rb_root *root)
+{
+	(void)node;
+	(void)root;
+}
+void rb_erase(struct rb_node *node, struct rb_root *root);
+struct rb_node *rb_first(const struct rb_root *root);
+struct rb_node *rb_next(const struct rb_node *node);
+
+/* ------------------------------------------------------------------ *
+ * scatterlist — flat array form; for_each_sg walks the array
+ * ------------------------------------------------------------------ */
+struct scatterlist {
+	u64 dma_address;
+	unsigned int dma_len;
+};
+struct sg_table {
+	struct scatterlist *sgl;
+	unsigned int nents;
+	unsigned int orig_nents;
+};
+#define sg_dma_address(sg) ((sg)->dma_address)
+#define sg_dma_len(sg) ((sg)->dma_len)
+#define for_each_sg(sglist, sg, nents, i) \
+	for ((i) = 0, (sg) = (sglist); (i) < (int)(nents); (i)++, (sg)++)
+
+/* ------------------------------------------------------------------ *
+ * device / module
+ * ------------------------------------------------------------------ */
+struct device {
+	const char *name;
+};
+struct module {
+	int dummy;
+};
+extern struct module mock_module;
+extern int mock_module_refs;
+#define THIS_MODULE (&mock_module)
+#define __module_get(mod) (void)(mock_module_refs++)
+#define module_put(mod) (void)(mock_module_refs--)
+
+#define MODULE_LICENSE(x)
+#define MODULE_DESCRIPTION(x)
+#define MODULE_AUTHOR(x)
+#define MODULE_VERSION(x)
+#define EXPORT_SYMBOL_GPL(sym)
+#define EXPORT_SYMBOL(sym)
+
+/* module_init runs at process start (constructor); module_exit is
+ * recorded so the harness can invoke the teardown path explicitly and
+ * assert on the post-exit state. */
+void mock_register_exit(void (*fn)(void));
+void mock_run_module_exits(void);
+#define module_init(fn)                                                   \
+	static void __attribute__((constructor(201))) mock_ctor_##fn(void) \
+	{                                                                  \
+		if (fn()) {                                                \
+			fprintf(stderr, "mock: module_init %s failed\n",   \
+				#fn);                                      \
+			exit(1);                                           \
+		}                                                          \
+	}
+#define module_exit(fn)                                                      \
+	static void __attribute__((constructor(202))) mock_exitreg_##fn(void) \
+	{                                                                    \
+		mock_register_exit(fn);                                      \
+	}
+
+/* ------------------------------------------------------------------ *
+ * chardev surface: file_operations + miscdevice + uaccess
+ * ------------------------------------------------------------------ */
+struct inode {
+	int unused;
+};
+struct file;
+struct vm_area_struct;
+struct file_operations {
+	struct module *owner;
+	int (*open)(struct inode *, struct file *);
+	int (*release)(struct inode *, struct file *);
+	long (*unlocked_ioctl)(struct file *, unsigned int, unsigned long);
+	int (*mmap)(struct file *, struct vm_area_struct *);
+};
+struct file {
+	void *private_data;
+	const struct file_operations *f_op;
+};
+
+#define MISC_DYNAMIC_MINOR 255
+struct miscdevice {
+	int minor;
+	const char *name;
+	const struct file_operations *fops;
+	unsigned short mode;
+	struct device *this_device;
+};
+int misc_register(struct miscdevice *misc);
+void misc_deregister(struct miscdevice *misc);
+
+/* Harness-side chardev access (the role the VFS plays in-kernel). */
+struct miscdevice *mock_misc_find(const char *name);
+struct file *mock_dev_open(const char *name);
+int mock_dev_close(struct file *filp);
+long mock_dev_ioctl(struct file *filp, unsigned int cmd, void *arg);
+
+static inline unsigned long copy_from_user(void *to, const void __user *from,
+					   unsigned long n)
+{
+	if (!from)
+		return n; /* EFAULT path */
+	memcpy(to, from, n);
+	return 0;
+}
+static inline unsigned long copy_to_user(void __user *to, const void *from,
+					 unsigned long n)
+{
+	if (!to)
+		return n;
+	memcpy(to, from, n);
+	return 0;
+}
+
+/* ------------------------------------------------------------------ *
+ * idr
+ * ------------------------------------------------------------------ */
+struct idr {
+	void **slots;
+	int cap;
+};
+void idr_init(struct idr *idr);
+int idr_alloc(struct idr *idr, void *ptr, int start, int end, gfp_t gfp);
+void *idr_remove(struct idr *idr, unsigned long id);
+void *idr_find(const struct idr *idr, unsigned long id);
+void idr_destroy(struct idr *idr);
+#define idr_for_each_entry(idr, entry, id)              \
+	for ((id) = 0; (id) < (idr)->cap; (id)++)       \
+		if (((entry) = (idr)->slots[id]) != NULL)
+
+/* ------------------------------------------------------------------ *
+ * mm: vma + remap_pfn_range. Mappings are recorded for the harness to
+ * verify sg-walk coverage (the reference's mmap bug — first entry
+ * only, tests/amdp2ptest.c:389 — is exactly what this catches).
+ * ------------------------------------------------------------------ */
+struct vm_area_struct {
+	unsigned long vm_start;
+	unsigned long vm_end;
+	unsigned long vm_pgoff;
+	pgprot_t vm_page_prot;
+};
+int remap_pfn_range(struct vm_area_struct *vma, unsigned long addr,
+		    unsigned long pfn, unsigned long size, pgprot_t prot);
+
+struct mock_map_segment {
+	unsigned long uaddr;
+	unsigned long pfn;
+	unsigned long size;
+};
+void mock_mmap_reset(void);
+int mock_mmap_segment_count(void);
+const struct mock_map_segment *mock_mmap_segment(int i);
+
+/* ------------------------------------------------------------------ *
+ * dma-buf — mock exporter with refcounts, page-granular sg tables over
+ * a malloc'd backing store, and harness-triggered move_notify
+ * ------------------------------------------------------------------ */
+enum dma_data_direction {
+	DMA_BIDIRECTIONAL = 0,
+	DMA_TO_DEVICE = 1,
+	DMA_FROM_DEVICE = 2,
+};
+
+struct dma_resv {
+	struct mutex lock;
+};
+static inline void dma_resv_lock(struct dma_resv *resv, void *ctx)
+{
+	(void)ctx;
+	mutex_lock(&resv->lock);
+}
+static inline void dma_resv_unlock(struct dma_resv *resv)
+{
+	mutex_unlock(&resv->lock);
+}
+
+struct dma_buf;
+struct dma_buf_attachment;
+struct dma_buf_attach_ops {
+	bool allow_peer2peer;
+	void (*move_notify)(struct dma_buf_attachment *attach);
+};
+struct dma_buf_attachment {
+	struct dma_buf *dmabuf;
+	struct device *dev;
+	void *importer_priv;
+	const struct dma_buf_attach_ops *importer_ops;
+	struct sg_table *sgt; /* live mapping, if any */
+	struct dma_buf_attachment *next;
+};
+struct dma_buf {
+	void *backing;
+	size_t size;
+	int refcount;
+	int fd;
+	struct dma_resv resv_storage;
+	struct dma_resv *resv;
+	struct dma_buf_attachment *attachments;
+};
+
+struct dma_buf *dma_buf_get(int fd);
+void get_dma_buf(struct dma_buf *dmabuf);
+void dma_buf_put(struct dma_buf *dmabuf);
+struct dma_buf_attachment *dma_buf_attach(struct dma_buf *dmabuf,
+					  struct device *dev);
+struct dma_buf_attachment *
+dma_buf_dynamic_attach(struct dma_buf *dmabuf, struct device *dev,
+		       const struct dma_buf_attach_ops *ops, void *priv);
+void dma_buf_detach(struct dma_buf *dmabuf, struct dma_buf_attachment *att);
+struct sg_table *dma_buf_map_attachment(struct dma_buf_attachment *att,
+					enum dma_data_direction dir);
+void dma_buf_unmap_attachment(struct dma_buf_attachment *att,
+			      struct sg_table *sgt,
+			      enum dma_data_direction dir);
+
+/* Harness-side exporter controls. */
+int mock_dmabuf_create(size_t size); /* returns an "fd" */
+void *mock_dmabuf_mem(int fd);
+void mock_dmabuf_fd_close(int fd); /* drop the fd's own reference */
+void mock_dmabuf_move(int fd);     /* fire move_notify on dynamic attachments */
+int mock_dmabuf_live_bufs(void);
+int mock_dmabuf_live_attachments(void);
+int mock_dmabuf_live_mappings(void);
+
+/* ------------------------------------------------------------------ *
+ * peer-memory registration (role of OFED ib_core)
+ * ------------------------------------------------------------------ */
+struct peer_memory_client;
+const struct peer_memory_client *mock_peer_client(void);
+int mock_invalidate_count(void);
+u64 mock_last_invalidated_core_context(void);
+
+#endif /* MOCK_KERNEL_H */

@@ -1,0 +1,566 @@
+/* SPDX-License-Identifier: MIT */
+/* Implementation of the userspace kernel mock (see include/mock/). */
+
+#include <mock/mock_kernel.h>
+
+#include "../tpup2p/peer_mem_compat.h"
+
+/* ------------------------------------------------------------------ *
+ * logging
+ * ------------------------------------------------------------------ */
+void mock_log(const char *lvl, const char *fmt, ...)
+{
+	va_list ap;
+
+	if (!getenv("MOCK_KERNEL_VERBOSE"))
+		return;
+	fprintf(stderr, "[mock:%s] ", lvl);
+	va_start(ap, fmt);
+	vfprintf(stderr, fmt, ap);
+	va_end(ap);
+}
+
+/* ------------------------------------------------------------------ *
+ * slab
+ * ------------------------------------------------------------------ */
+int mock_kzalloc_live;
+int mock_fail_next_kzalloc;
+
+void *mock_kzalloc(size_t n)
+{
+	if (mock_fail_next_kzalloc > 0) {
+		mock_fail_next_kzalloc--;
+		return NULL;
+	}
+	mock_kzalloc_live++;
+	return calloc(1, n);
+}
+
+void mock_kfree(void *p)
+{
+	if (!p)
+		return;
+	mock_kzalloc_live--;
+	free(p);
+}
+
+/* ------------------------------------------------------------------ *
+ * pids
+ * ------------------------------------------------------------------ */
+static pid_t mock_tgid_override;
+
+pid_t mock_task_tgid_nr(void)
+{
+	return mock_tgid_override ? mock_tgid_override : getpid();
+}
+
+void mock_set_tgid(pid_t tgid)
+{
+	mock_tgid_override = tgid;
+}
+
+/* ------------------------------------------------------------------ *
+ * module
+ * ------------------------------------------------------------------ */
+struct module mock_module;
+int mock_module_refs;
+
+static void (*mock_exit_fns[8])(void);
+static int mock_exit_count;
+
+void mock_register_exit(void (*fn)(void))
+{
+	if (mock_exit_count < 8)
+		mock_exit_fns[mock_exit_count++] = fn;
+}
+
+void mock_run_module_exits(void)
+{
+	/* Reverse registration order, as rmmod unwinds a dependency
+	 * stack (test module before the bridge it links against). */
+	while (mock_exit_count > 0)
+		mock_exit_fns[--mock_exit_count]();
+}
+
+/* ------------------------------------------------------------------ *
+ * rbtree (plain BST with parent pointers; API-compatible)
+ * ------------------------------------------------------------------ */
+static struct rb_node *rb_leftmost(struct rb_node *n)
+{
+	while (n && n->rb_left)
+		n = n->rb_left;
+	return n;
+}
+
+struct rb_node *rb_first(const struct rb_root *root)
+{
+	return rb_leftmost(root->rb_node);
+}
+
+struct rb_node *rb_next(const struct rb_node *node)
+{
+	struct rb_node *n = (struct rb_node *)node;
+
+	if (n->rb_right)
+		return rb_leftmost(n->rb_right);
+	while (n->rb_parent && n == n->rb_parent->rb_right)
+		n = n->rb_parent;
+	return n->rb_parent;
+}
+
+static void rb_replace_child(struct rb_root *root, struct rb_node *parent,
+			     struct rb_node *old, struct rb_node *new)
+{
+	if (!parent)
+		root->rb_node = new;
+	else if (parent->rb_left == old)
+		parent->rb_left = new;
+	else
+		parent->rb_right = new;
+	if (new)
+		new->rb_parent = parent;
+}
+
+void rb_erase(struct rb_node *node, struct rb_root *root)
+{
+	if (!node->rb_left) {
+		rb_replace_child(root, node->rb_parent, node, node->rb_right);
+	} else if (!node->rb_right) {
+		rb_replace_child(root, node->rb_parent, node, node->rb_left);
+	} else {
+		/* Two children: splice in the in-order successor. */
+		struct rb_node *succ = rb_leftmost(node->rb_right);
+
+		if (succ->rb_parent != node) {
+			rb_replace_child(root, succ->rb_parent, succ,
+					 succ->rb_right);
+			succ->rb_right = node->rb_right;
+			succ->rb_right->rb_parent = succ;
+		}
+		succ->rb_left = node->rb_left;
+		succ->rb_left->rb_parent = succ;
+		rb_replace_child(root, node->rb_parent, node, succ);
+	}
+	node->rb_left = node->rb_right = node->rb_parent = NULL;
+}
+
+/* ------------------------------------------------------------------ *
+ * miscdevice + VFS-lite
+ * ------------------------------------------------------------------ */
+#define MOCK_MAX_MISC 8
+static struct miscdevice *mock_miscs[MOCK_MAX_MISC];
+static struct device mock_misc_parent_devs[MOCK_MAX_MISC];
+
+int misc_register(struct miscdevice *misc)
+{
+	for (int i = 0; i < MOCK_MAX_MISC; i++) {
+		if (!mock_miscs[i]) {
+			mock_miscs[i] = misc;
+			mock_misc_parent_devs[i].name = misc->name;
+			misc->this_device = &mock_misc_parent_devs[i];
+			return 0;
+		}
+	}
+	return -ENOMEM;
+}
+
+void misc_deregister(struct miscdevice *misc)
+{
+	for (int i = 0; i < MOCK_MAX_MISC; i++)
+		if (mock_miscs[i] == misc)
+			mock_miscs[i] = NULL;
+	misc->this_device = NULL;
+}
+
+struct miscdevice *mock_misc_find(const char *name)
+{
+	for (int i = 0; i < MOCK_MAX_MISC; i++)
+		if (mock_miscs[i] && strcmp(mock_miscs[i]->name, name) == 0)
+			return mock_miscs[i];
+	return NULL;
+}
+
+struct file *mock_dev_open(const char *name)
+{
+	struct miscdevice *misc = mock_misc_find(name);
+	struct file *filp;
+	static struct inode dummy_inode;
+
+	if (!misc)
+		return NULL;
+	filp = calloc(1, sizeof(*filp));
+	filp->f_op = misc->fops;
+	if (misc->fops->open && misc->fops->open(&dummy_inode, filp)) {
+		free(filp);
+		return NULL;
+	}
+	return filp;
+}
+
+int mock_dev_close(struct file *filp)
+{
+	static struct inode dummy_inode;
+	int ret = 0;
+
+	if (filp->f_op->release)
+		ret = filp->f_op->release(&dummy_inode, filp);
+	free(filp);
+	return ret;
+}
+
+long mock_dev_ioctl(struct file *filp, unsigned int cmd, void *arg)
+{
+	if (!filp->f_op->unlocked_ioctl)
+		return -ENOTTY;
+	return filp->f_op->unlocked_ioctl(filp, cmd, (unsigned long)arg);
+}
+
+/* ------------------------------------------------------------------ *
+ * idr
+ * ------------------------------------------------------------------ */
+void idr_init(struct idr *idr)
+{
+	idr->slots = NULL;
+	idr->cap = 0;
+}
+
+int idr_alloc(struct idr *idr, void *ptr, int start, int end, gfp_t gfp)
+{
+	int id;
+
+	(void)gfp;
+	if (start < 0)
+		return -EINVAL;
+	for (id = start; end <= 0 || id < end; id++) {
+		if (id >= idr->cap) {
+			int ncap = id + 8;
+			void **n = realloc(idr->slots,
+					   ncap * sizeof(void *));
+
+			if (!n)
+				return -ENOMEM;
+			memset(n + idr->cap, 0,
+			       (ncap - idr->cap) * sizeof(void *));
+			idr->slots = n;
+			idr->cap = ncap;
+		}
+		if (!idr->slots[id]) {
+			idr->slots[id] = ptr;
+			return id;
+		}
+	}
+	return -ENOSPC;
+}
+
+void *idr_remove(struct idr *idr, unsigned long id)
+{
+	void *p;
+
+	if ((int)id >= idr->cap)
+		return NULL;
+	p = idr->slots[id];
+	idr->slots[id] = NULL;
+	return p;
+}
+
+void *idr_find(const struct idr *idr, unsigned long id)
+{
+	if ((int)id >= idr->cap)
+		return NULL;
+	return idr->slots[id];
+}
+
+void idr_destroy(struct idr *idr)
+{
+	free(idr->slots);
+	idr->slots = NULL;
+	idr->cap = 0;
+}
+
+/* ------------------------------------------------------------------ *
+ * mm
+ * ------------------------------------------------------------------ */
+#define MOCK_MAX_SEGMENTS 128
+static struct mock_map_segment mock_segments[MOCK_MAX_SEGMENTS];
+static int mock_segment_count;
+
+int remap_pfn_range(struct vm_area_struct *vma, unsigned long addr,
+		    unsigned long pfn, unsigned long size, pgprot_t prot)
+{
+	(void)vma;
+	(void)prot;
+	if (mock_segment_count >= MOCK_MAX_SEGMENTS)
+		return -ENOMEM;
+	mock_segments[mock_segment_count++] =
+		(struct mock_map_segment){ addr, pfn, size };
+	return 0;
+}
+
+void mock_mmap_reset(void)
+{
+	mock_segment_count = 0;
+}
+
+int mock_mmap_segment_count(void)
+{
+	return mock_segment_count;
+}
+
+const struct mock_map_segment *mock_mmap_segment(int i)
+{
+	return &mock_segments[i];
+}
+
+/* ------------------------------------------------------------------ *
+ * dma-buf exporter
+ * ------------------------------------------------------------------ */
+#define MOCK_MAX_DMABUF 16
+static struct dma_buf *mock_bufs[MOCK_MAX_DMABUF];
+static int mock_next_fd = 100;
+static int mock_live_attachments;
+static int mock_live_mappings;
+
+int mock_dmabuf_create(size_t size)
+{
+	struct dma_buf *d = calloc(1, sizeof(*d));
+
+	d->backing = calloc(1, size);
+	d->size = size;
+	d->refcount = 1; /* the fd's own reference */
+	d->fd = mock_next_fd++;
+	mutex_init(&d->resv_storage.lock);
+	d->resv = &d->resv_storage;
+	for (int i = 0; i < MOCK_MAX_DMABUF; i++) {
+		if (!mock_bufs[i]) {
+			mock_bufs[i] = d;
+			return d->fd;
+		}
+	}
+	free(d->backing);
+	free(d);
+	return -1;
+}
+
+static struct dma_buf *mock_find_buf(int fd)
+{
+	for (int i = 0; i < MOCK_MAX_DMABUF; i++)
+		if (mock_bufs[i] && mock_bufs[i]->fd == fd)
+			return mock_bufs[i];
+	return NULL;
+}
+
+void *mock_dmabuf_mem(int fd)
+{
+	struct dma_buf *d = mock_find_buf(fd);
+
+	return d ? d->backing : NULL;
+}
+
+struct dma_buf *dma_buf_get(int fd)
+{
+	struct dma_buf *d = mock_find_buf(fd);
+
+	if (!d)
+		return ERR_PTR(-EBADF);
+	d->refcount++;
+	return d;
+}
+
+void get_dma_buf(struct dma_buf *dmabuf)
+{
+	dmabuf->refcount++;
+}
+
+void dma_buf_put(struct dma_buf *dmabuf)
+{
+	if (--dmabuf->refcount > 0)
+		return;
+	for (int i = 0; i < MOCK_MAX_DMABUF; i++)
+		if (mock_bufs[i] == dmabuf)
+			mock_bufs[i] = NULL;
+	free(dmabuf->backing);
+	free(dmabuf);
+}
+
+void mock_dmabuf_fd_close(int fd)
+{
+	struct dma_buf *d = mock_find_buf(fd);
+
+	if (d)
+		dma_buf_put(d);
+}
+
+static struct dma_buf_attachment *
+mock_attach(struct dma_buf *dmabuf, struct device *dev,
+	    const struct dma_buf_attach_ops *ops, void *priv)
+{
+	struct dma_buf_attachment *att = calloc(1, sizeof(*att));
+
+	att->dmabuf = dmabuf;
+	att->dev = dev;
+	att->importer_ops = ops;
+	att->importer_priv = priv;
+	att->next = dmabuf->attachments;
+	dmabuf->attachments = att;
+	mock_live_attachments++;
+	return att;
+}
+
+struct dma_buf_attachment *dma_buf_attach(struct dma_buf *dmabuf,
+					  struct device *dev)
+{
+	if (!dev)
+		return ERR_PTR(-EINVAL);
+	return mock_attach(dmabuf, dev, NULL, NULL);
+}
+
+struct dma_buf_attachment *
+dma_buf_dynamic_attach(struct dma_buf *dmabuf, struct device *dev,
+		       const struct dma_buf_attach_ops *ops, void *priv)
+{
+	if (!dev)
+		return ERR_PTR(-EINVAL);
+	if (ops && !ops->move_notify)
+		return ERR_PTR(-EINVAL); /* dynamic importers must handle moves */
+	return mock_attach(dmabuf, dev, ops, priv);
+}
+
+void dma_buf_detach(struct dma_buf *dmabuf, struct dma_buf_attachment *att)
+{
+	struct dma_buf_attachment **p = &dmabuf->attachments;
+
+	if (att->sgt) {
+		fprintf(stderr, "mock: BUG: detach with live mapping\n");
+		exit(1);
+	}
+	while (*p && *p != att)
+		p = &(*p)->next;
+	if (*p)
+		*p = att->next;
+	mock_live_attachments--;
+	free(att);
+}
+
+struct sg_table *dma_buf_map_attachment(struct dma_buf_attachment *att,
+					enum dma_data_direction dir)
+{
+	struct dma_buf *d = att->dmabuf;
+	unsigned int nents = (d->size + PAGE_SIZE - 1) / PAGE_SIZE;
+	struct sg_table *sgt;
+
+	(void)dir;
+	if (att->sgt)
+		return ERR_PTR(-EBUSY); /* one mapping per attachment */
+	sgt = calloc(1, sizeof(*sgt));
+	sgt->sgl = calloc(nents, sizeof(struct scatterlist));
+	sgt->nents = sgt->orig_nents = nents;
+	for (unsigned int i = 0; i < nents; i++) {
+		size_t off = (size_t)i * PAGE_SIZE;
+
+		sgt->sgl[i].dma_address = (u64)(uintptr_t)d->backing + off;
+		sgt->sgl[i].dma_len =
+			(unsigned int)min(PAGE_SIZE, d->size - off);
+	}
+	att->sgt = sgt;
+	mock_live_mappings++;
+	return sgt;
+}
+
+void dma_buf_unmap_attachment(struct dma_buf_attachment *att,
+			      struct sg_table *sgt,
+			      enum dma_data_direction dir)
+{
+	(void)dir;
+	if (att->sgt != sgt) {
+		fprintf(stderr, "mock: BUG: unmap of foreign/stale sg_table\n");
+		exit(1);
+	}
+	att->sgt = NULL;
+	mock_live_mappings--;
+	free(sgt->sgl);
+	free(sgt);
+}
+
+void mock_dmabuf_move(int fd)
+{
+	struct dma_buf *d = mock_find_buf(fd);
+	struct dma_buf_attachment *att, *next;
+
+	if (!d)
+		return;
+	/* Exporters fire move_notify holding the resv lock; importers'
+	 * callbacks may unmap (locked variant) but not detach. */
+	mutex_lock(&d->resv->lock);
+	for (att = d->attachments; att; att = next) {
+		next = att->next;
+		if (att->importer_ops && att->importer_ops->move_notify)
+			att->importer_ops->move_notify(att);
+	}
+	mutex_unlock(&d->resv->lock);
+}
+
+int mock_dmabuf_live_bufs(void)
+{
+	int n = 0;
+
+	for (int i = 0; i < MOCK_MAX_DMABUF; i++)
+		if (mock_bufs[i])
+			n++;
+	return n;
+}
+
+int mock_dmabuf_live_attachments(void)
+{
+	return mock_live_attachments;
+}
+
+int mock_dmabuf_live_mappings(void)
+{
+	return mock_live_mappings;
+}
+
+/* ------------------------------------------------------------------ *
+ * peer-memory registration (ib_core's role)
+ * ------------------------------------------------------------------ */
+static const struct peer_memory_client *mock_registered_client;
+static int mock_invalidations;
+static u64 mock_last_core_context;
+
+static int mock_invalidate(void *reg_handle, u64 core_context)
+{
+	(void)reg_handle;
+	mock_invalidations++;
+	mock_last_core_context = core_context;
+	return 0;
+}
+
+void *ib_register_peer_memory_client(const struct peer_memory_client *client,
+				     invalidate_peer_memory *invalidate_cb)
+{
+	if (mock_registered_client)
+		return NULL; /* one client in this mock */
+	mock_registered_client = client;
+	*invalidate_cb = mock_invalidate;
+	return (void *)&mock_registered_client;
+}
+
+void ib_unregister_peer_memory_client(void *reg_handle)
+{
+	(void)reg_handle;
+	mock_registered_client = NULL;
+}
+
+const struct peer_memory_client *mock_peer_client(void)
+{
+	return mock_registered_client;
+}
+
+int mock_invalidate_count(void)
+{
+	return mock_invalidations;
+}
+
+u64 mock_last_invalidated_core_context(void)
+{
+	return mock_last_core_context;
+}

@@ -426,7 +426,7 @@ class EmuQp : public Qp {
       std::vector<char> payload = std::move(unexpected_.front());
       unexpected_.pop_front();
       lk.unlock();
-      deliver_buffer(r, payload.data(), payload.size());
+      push_wc(deliver_buffer_wc(r, payload.data(), payload.size()));
       return 0;
     }
     recvs_.push_back(r);
@@ -434,28 +434,29 @@ class EmuQp : public Qp {
   }
 
   // Land a payload already in local memory into a posted recv (store
-  // or fold) and complete it.
-  void deliver_buffer(const PostedRecv &r, const char *data, size_t len) {
+  // or fold); returns the completion (caller pushes it — see
+  // handle_send_inbound for why delivery is deferred).
+  tdr_wc deliver_buffer_wc(const PostedRecv &r, const char *data,
+                           size_t len) {
     if (len > r.maxlen ||
-        (r.is_reduce && len % dtype_size(r.dtype) != 0)) {
-      push_wc({r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len});
-      return;
-    }
+        (r.is_reduce && len % dtype_size(r.dtype) != 0))
+      return {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
     if (r.is_reduce)
       par_reduce(r.dst, data, len / dtype_size(r.dtype), r.dtype, r.red_op);
     else
       par_memcpy(r.dst, data, len);
-    push_wc({r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, len});
+    return {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, len};
   }
 
-  // Land a streamed payload from the socket. Reduce recvs fold the
-  // wire bytes through a small stack window — streaming reduction, no
-  // scratch allocation. Returns false only on connection loss.
-  bool land_stream(const PostedRecv &r, uint64_t len) {
+  // Land a streamed payload from the socket into *wc. Reduce recvs
+  // fold the wire bytes through a small stack window — streaming
+  // reduction, no scratch allocation. Returns false only on
+  // connection loss.
+  bool land_stream_wc(const PostedRecv &r, uint64_t len, tdr_wc *wc) {
     if (len > r.maxlen ||
         (r.is_reduce && len % dtype_size(r.dtype) != 0)) {
       if (!drain(len)) return false;
-      push_wc({r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len});
+      *wc = {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
       return true;
     }
     if (!r.is_reduce) {
@@ -474,17 +475,19 @@ class EmuQp : public Qp {
         left -= chunk;
       }
     }
-    push_wc({r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, len});
+    *wc = {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, len};
     return true;
   }
 
-  // Land a CMA payload (peer VA `src`). Same-process reduce reads the
-  // peer buffer in place — zero intermediate bytes; cross-process
-  // reduce streams through a cache-sized window.
-  bool land_cma(const PostedRecv &r, uint64_t src, uint64_t len) {
+  // Land a CMA payload (peer VA `src`) into *wc. Same-process reduce
+  // reads the peer buffer in place — zero intermediate bytes;
+  // cross-process reduce streams through a cache-sized window.
+  // Returns whether the data movement succeeded (the ack status).
+  bool land_cma_wc(const PostedRecv &r, uint64_t src, uint64_t len,
+                   tdr_wc *wc) {
     if (len > r.maxlen ||
         (r.is_reduce && len % dtype_size(r.dtype) != 0)) {
-      push_wc({r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len});
+      *wc = {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
       return true;  // desc mode: nothing on the wire to drain
     }
     bool ok;
@@ -492,8 +495,8 @@ class EmuQp : public Qp {
       ok = par_cma_copy_from(peer_pid_, r.dst, src, len);
     else
       ok = par_cma_reduce_from(peer_pid_, r.dst, src, len, r.dtype, r.red_op);
-    push_wc({r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
-             TDR_OP_RECV, len});
+    *wc = {r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
+           TDR_OP_RECV, len};
     return ok;
   }
 
@@ -590,15 +593,18 @@ class EmuQp : public Qp {
     cv_.notify_all();
   }
 
-  // Shared OP_SEND / OP_SEND_DESC skeleton: match the inbound message
-  // to a posted recv, else bounce-buffer the payload and re-check (a
-  // recv may have been posted while the payload was being fetched —
-  // it saw unexpected_ empty and queued itself; deliver rather than
-  // strand it). Returns the ack status; sets *dead on connection loss
-  // (stream-tier fetch/land failures are connection loss; CMA-tier
-  // failures are reportable errors).
-  uint8_t handle_send_inbound(const FrameHdr &h, bool desc, bool *dead) {
-    *dead = false;
+  // Shared OP_SEND / OP_SEND_DESC skeleton, end to end: match the
+  // inbound message to a posted recv (else bounce-buffer the payload
+  // and re-check — a recv may have been posted while the payload was
+  // being fetched; it saw unexpected_ empty and queued itself, so
+  // deliver rather than strand it), write the ack, THEN deliver the
+  // local completion. Ack-before-completion is load-bearing: a peer
+  // whose collective finishes on the heels of our completion may
+  // close the QP immediately, and an ack queued after the local push
+  // can lose the send_mu_ race to that close's GOODBYE — and be cut
+  // off entirely by its socket shutdown — flushing the peer's last
+  // send with an error. Returns false on connection loss.
+  bool handle_send_inbound(const FrameHdr &h, bool desc) {
     PostedRecv r{};
     bool have = false;
     {
@@ -609,29 +615,44 @@ class EmuQp : public Qp {
         have = true;
       }
     }
+    FrameHdr ack{};
+    ack.op = OP_SEND_ACK;
+    ack.seq = h.seq;
     if (have) {
-      if (desc)
-        return land_cma(r, h.aux, h.len) ? TDR_WC_SUCCESS
-                                         : TDR_WC_GENERAL_ERR;
-      if (!land_stream(r, h.len)) *dead = true;
-      return TDR_WC_SUCCESS;
+      tdr_wc wc;
+      if (desc) {
+        ack.status = land_cma_wc(r, h.aux, h.len, &wc)
+                         ? TDR_WC_SUCCESS
+                         : TDR_WC_GENERAL_ERR;
+      } else {
+        if (!land_stream_wc(r, h.len, &wc)) return false;
+        ack.status = TDR_WC_SUCCESS;
+      }
+      bool sent = send_frame(ack, nullptr, 0);
+      push_wc(wc);
+      return sent;
     }
     // Unexpected message: materialize it now. In desc mode the
     // sender's buffer is only promised stable until its completion,
     // which our ack produces — so the copy must happen before the ack.
+    // The bounce buffer's size is wire-controlled: cap it so a corrupt
+    // peer can't bad_alloc the progress thread (legit unexpected
+    // messages are ring chunks, MBs at most); an oversized frame kills
+    // this QP only — RC flush semantics, not process death.
+    constexpr uint64_t kMaxUnexpectedBytes = 1ull << 30;
+    if (h.len > kMaxUnexpectedBytes) return false;
     std::vector<char> buf(h.len);
     bool ok;
     if (desc) {
       ok = h.len == 0 ||
            par_cma_copy_from(peer_pid_, buf.data(), h.aux, h.len);
     } else {
-      if (h.len && !read_full(fd_, buf.data(), h.len)) {
-        *dead = true;
-        return 0;
-      }
+      if (h.len && !read_full(fd_, buf.data(), h.len)) return false;
       ok = true;
     }
     if (!ok) buf.clear();
+    ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
+    bool sent = send_frame(ack, nullptr, 0);
     PostedRecv r2{};
     bool have2 = false;
     {
@@ -646,11 +667,11 @@ class EmuQp : public Qp {
     }
     if (have2) {
       if (ok)
-        deliver_buffer(r2, buf.data(), buf.size());
+        push_wc(deliver_buffer_wc(r2, buf.data(), buf.size()));
       else
         push_wc({r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
     }
-    return ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
+    return sent;
   }
 
   // Drain len payload bytes we cannot place (bad rkey etc.).
@@ -708,13 +729,7 @@ class EmuQp : public Qp {
           break;
         }
         case OP_SEND: {
-          bool dead = false;
-          FrameHdr ack{};
-          ack.op = OP_SEND_ACK;
-          ack.seq = h.seq;
-          ack.status = handle_send_inbound(h, /*desc=*/false, &dead);
-          if (dead) goto out;
-          if (!send_frame(ack, nullptr, 0)) goto out;
+          if (!handle_send_inbound(h, /*desc=*/false)) goto out;
           break;
         }
         case OP_WRITE_DESC: {
@@ -758,13 +773,7 @@ class EmuQp : public Qp {
         }
         case OP_SEND_DESC: {
           if (!cma_) goto out;
-          bool dead = false;
-          FrameHdr ack{};
-          ack.op = OP_SEND_ACK;
-          ack.seq = h.seq;
-          ack.status = handle_send_inbound(h, /*desc=*/true, &dead);
-          if (dead) goto out;
-          if (!send_frame(ack, nullptr, 0)) goto out;
+          if (!handle_send_inbound(h, /*desc=*/true)) goto out;
           break;
         }
         case OP_WRITE_ACK:
