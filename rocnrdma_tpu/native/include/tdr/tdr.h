@@ -131,6 +131,18 @@ int tdr_post_recv_reduce(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t maxlen,
                          int dtype, int red_op, uint64_t wr_id);
 int tdr_qp_has_recv_reduce(tdr_qp *qp);
 
+/* Fused fold-and-write-back send (the other half of an in-transport
+ * allreduce exchange): like tdr_post_send, but the peer — having
+ * matched this message to a tdr_post_recv_reduce — folds the payload
+ * into its buffer AND writes the folded result back IN PLACE over
+ * this send's source region, all in one pass while the data is hot.
+ * The send completion fires only after the write-back has landed, so
+ * for a symmetric exchange no separate return transfer (all-gather
+ * phase) is needed at all. Capability-gated like recv_reduce. */
+int tdr_post_send_foldback(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t len,
+                           uint64_t wr_id);
+int tdr_qp_has_send_foldback(tdr_qp *qp);
+
 /* Poll up to `max` completions; waits up to timeout_ms (0 = non-block,
  * -1 = forever). Returns count, or -1 on error. */
 int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms);

@@ -131,6 +131,16 @@ int tdr_qp_has_recv_reduce(tdr_qp *qp) {
   return reinterpret_cast<Qp *>(qp)->has_recv_reduce() ? 1 : 0;
 }
 
+int tdr_post_send_foldback(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t len,
+                           uint64_t wr_id) {
+  return reinterpret_cast<Qp *>(qp)->post_send_foldback(
+      reinterpret_cast<Mr *>(lmr), loff, len, wr_id);
+}
+
+int tdr_qp_has_send_foldback(tdr_qp *qp) {
+  return reinterpret_cast<Qp *>(qp)->has_send_foldback() ? 1 : 0;
+}
+
 int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms) {
   return reinterpret_cast<Qp *>(qp)->poll(wc, max, timeout_ms);
 }

@@ -53,6 +53,15 @@ class Qp {
     return -1;
   }
   virtual bool has_recv_reduce() const { return false; }
+  // Fused fold-and-write-back send: the peer folds the payload into
+  // its matched recv_reduce buffer and writes the folded result back
+  // in place over this send's source; completion fires after the
+  // write-back lands (see tdr.h).
+  virtual int post_send_foldback(Mr *, size_t, size_t, uint64_t) {
+    set_error("send_foldback: not supported by this engine");
+    return -1;
+  }
+  virtual bool has_send_foldback() const { return false; }
   virtual int poll(tdr_wc *wc, int max, int timeout_ms) = 0;
   virtual int close_qp() = 0;
 };
@@ -78,6 +87,9 @@ size_t dtype_size(int dt);
 // dst[i] op= src[i] for n elements of dtype dt (bf16 accumulates in
 // f32 with round-to-nearest-even, matching TPU semantics).
 void reduce_any(void *dst, const void *src, size_t n, int dt, int op);
+// Fused exchange fold: res = dst op src written to BOTH buffers in
+// one pass (bit-identical on both sides; bf16 rounds once).
+void reduce2_any(void *dst, void *src, size_t n, int dt, int op);
 
 // Parallel data movement (copy_pool.cc): a process-wide worker pool —
 // the emulated NIC's DMA-engine array. All entry points fall back to
@@ -98,6 +110,18 @@ bool par_cma_copy_from(pid_t pid, void *dst, uint64_t src, size_t len);
 bool par_cma_copy_to(pid_t pid, uint64_t dst, const void *src, size_t len);
 bool par_cma_reduce_from(pid_t pid, void *dst, uint64_t src, size_t bytes,
                          int dt, int op);
+// Non-temporal copy for large cold destinations (streaming stores;
+// bypasses the read-for-ownership a cached store pays).
+void copy_nt(char *dst, const char *src, size_t len);
+// Fused exchange fold: res = dst op src; written to BOTH dst (cached)
+// and src (streamed) — the one-pass kernel behind send_foldback when
+// both buffers are in this address space.
+void par_reduce2_local(void *dst, void *src, size_t n, int dt, int op);
+// Cross-process variant: fold peer bytes at `src` (pid's address
+// space) into dst, writing the folded result back to the peer — one
+// windowed pass. Returns false on CMA failure.
+bool par_cma_reduce2(pid_t pid, void *dst, uint64_t src, size_t bytes,
+                     int dt, int op);
 
 // TCP helpers (bootstrap for both backends; data path for emu).
 int tcp_listen_accept(const char *bind_host, int port, std::string *err);

@@ -57,6 +57,15 @@ enum WireOp : uint8_t {
   OP_WRITE_DESC = 8,
   OP_READ_REQ_DESC = 9,
   OP_SEND_DESC = 10,
+  // Fold-and-write-back sends (tdr_post_send_foldback): the receiver
+  // folds the payload into its matched recv_reduce buffer and returns
+  // the folded result in place over the sender's source. Stream tier:
+  // payload follows the FB frame and the folded bytes ride back on
+  // the ack; CMA tier: the receiver's fused kernel writes the peer's
+  // memory directly and the ack is bare.
+  OP_SEND_FB = 11,
+  OP_SEND_FB_DESC = 12,
+  OP_SEND_FB_ACK = 13,
 };
 
 #pragma pack(push, 1)
@@ -72,6 +81,15 @@ struct FrameHdr {
 };
 #pragma pack(pop)
 static_assert(sizeof(FrameHdr) == 40, "wire format");
+
+// Feature bits advertised in the handshake. Wire-protocol-changing
+// capabilities MUST be negotiated (mine & theirs), never assumed from
+// local state: a per-rank env override that silently changed the
+// frames one side emits would wedge the other (see FEAT_FOLDBACK —
+// its frames are only valid against a peer that folds them).
+enum : uint32_t {
+  FEAT_FOLDBACK = 1u << 0,
+};
 
 // Connection handshake: each side announces identity and a probe
 // address; each side then attempts a cross-memory read of the peer's
@@ -91,6 +109,8 @@ struct Hello {
   // pid comparison is namespace-relative (two containers both have a
   // "pid 1"), so it is never used to decide the memcpy fast path.
   uint64_t proc_token;
+  uint32_t features;  // FEAT_* this side is willing to speak
+  uint32_t pad;
 };
 struct HelloResult {
   uint8_t cma_ok;
@@ -129,10 +149,29 @@ std::string read_boot_id() {
   return std::string(buf);
 }
 
-bool cma_disabled() {
-  const char *env = getenv("TDR_NO_CMA");
+bool env_set(const char *name) {
+  const char *env = getenv(name);
   return env && *env && *env != '0';
 }
+
+bool cma_disabled() { return env_set("TDR_NO_CMA"); }
+
+// Locally-willing feature set. The env opt-outs act here, at the
+// advertising stage, so a rank with TDR_NO_FOLDBACK set degrades the
+// WHOLE connection to the compatible schedule instead of silently
+// emitting a different wire protocol than its peer expects.
+uint32_t local_features() {
+  uint32_t f = 0;
+  if (!env_set("TDR_NO_FOLDBACK") && !env_set("TDR_NO_FUSED2"))
+    f |= FEAT_FOLDBACK;
+  return f;
+}
+
+// Payload-size sanity cap for wire-controlled allocations (bounced
+// unexpected messages, foldback buffers): a corrupt peer must not be
+// able to bad_alloc the progress thread. Legit messages are ring
+// chunks — MBs.
+constexpr uint64_t kMaxUnexpectedBytes = 1ull << 30;
 
 // Single-copy moves between address spaces (cma_copy_from/to) live in
 // copy_pool.cc along with the pool-parallel wrappers used below — the
@@ -374,6 +413,34 @@ class EmuQp : public Qp {
     return queue_recv({wr_id, dst, maxlen, false, 0, 0});
   }
 
+  int post_send_foldback(Mr *lmr, size_t loff, size_t len,
+                         uint64_t wr_id) override {
+    if (!(features_ & FEAT_FOLDBACK)) {
+      set_error("post_send_foldback: not negotiated with peer");
+      return -1;
+    }
+    char *src = eng_->local_ptr(lmr, loff, len);
+    if (!src) {
+      set_error("post_send_foldback: invalid local MR range");
+      return -1;
+    }
+    FrameHdr h{};
+    h.op = cma_ ? OP_SEND_FB_DESC : OP_SEND_FB;
+    h.len = len;
+    h.aux = reinterpret_cast<uint64_t>(src);
+    // dst = src: the folded result lands back over the source region
+    // (stream tier reads the ack payload into it; CMA tier is written
+    // remotely and the pending needs no landing).
+    h.seq = new_pending(wr_id, TDR_OP_SEND, src, len);
+    bool ok = cma_ ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
+    if (!ok) return fail_pending(h.seq);
+    return 0;
+  }
+
+  bool has_send_foldback() const override {
+    return (features_ & FEAT_FOLDBACK) != 0;
+  }
+
   int post_recv_reduce(Mr *lmr, size_t loff, size_t maxlen, int dtype,
                        int red_op, uint64_t wr_id) override {
     if (dtype_size(dtype) == 0) {
@@ -418,19 +485,77 @@ class EmuQp : public Qp {
   }
 
  private:
+  // An inbound message that arrived before any recv was posted. For
+  // plain sends the payload is materialized (and already acked); for
+  // foldback sends the ACK MUST WAIT for the fold, so the entry keeps
+  // the seq (and, desc tier, the peer VA) and is resolved when a recv
+  // shows up.
+  struct Unexpected {
+    std::vector<char> payload;
+    bool fb = false;
+    bool desc = false;
+    uint64_t seq = 0;
+    uint64_t src_va = 0;
+    uint64_t len = 0;
+  };
+
   // Common tail of post_recv/post_recv_reduce: consume a buffered
   // unexpected message if one raced ahead, else enqueue.
   int queue_recv(PostedRecv r) {
     std::unique_lock<std::mutex> lk(mu_);
     if (!unexpected_.empty()) {
-      std::vector<char> payload = std::move(unexpected_.front());
+      Unexpected u = std::move(unexpected_.front());
       unexpected_.pop_front();
       lk.unlock();
-      push_wc(deliver_buffer_wc(r, payload.data(), payload.size()));
+      if (!u.fb) {
+        push_wc(deliver_buffer_wc(r, u.payload.data(), u.payload.size()));
+        return 0;
+      }
+      finish_foldback(r, u);
       return 0;
     }
     recvs_.push_back(r);
     return 0;
+  }
+
+  // Shared tail of every foldback delivery (matched immediately or
+  // deferred): validate, fold + write back, ack (which releases the
+  // sender), then deliver the local completion. The payload source is
+  // the peer VA (desc tier) or `u.payload`, folded in place and
+  // returned on the ack (stream tier). Returns the ack write's
+  // success.
+  bool finish_foldback(const PostedRecv &r, Unexpected &u) {
+    FrameHdr ack{};
+    ack.op = OP_SEND_FB_ACK;
+    ack.seq = u.seq;
+    bool fold_ok = r.is_reduce && u.len <= r.maxlen &&
+                   dtype_size(r.dtype) != 0 &&
+                   u.len % dtype_size(r.dtype) == 0;
+    bool sent;
+    if (!fold_ok) {
+      ack.status = TDR_WC_LOC_ACCESS_ERR;
+      sent = send_frame(ack, nullptr, 0);
+      push_wc({r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, u.len});
+      return sent;
+    }
+    if (u.desc) {
+      bool ok = par_cma_reduce2(peer_pid_, r.dst, u.src_va, u.len, r.dtype,
+                                r.red_op);
+      ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
+      sent = send_frame(ack, nullptr, 0);
+      push_wc({r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
+               TDR_OP_RECV, u.len});
+      return sent;
+    }
+    // Stream tier: fold the payload in place (it ends up holding the
+    // folded values) and return it on the ack.
+    reduce2_any(r.dst, u.payload.data(), u.len / dtype_size(r.dtype),
+                r.dtype, r.red_op);
+    ack.status = TDR_WC_SUCCESS;
+    ack.len = u.len;
+    sent = send_frame(ack, u.payload.data(), u.payload.size());
+    push_wc({r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
+    return sent;
   }
 
   // Land a payload already in local memory into a posted recv (store
@@ -510,9 +635,10 @@ class EmuQp : public Qp {
     probe_val_ = kHelloMagic ^ reinterpret_cast<uint64_t>(this);
     Hello mine{};
     mine.magic = kHelloMagic;
-    mine.version = 3;
+    mine.version = 4;
     mine.pid = getpid();
     mine.uid = getuid();
+    mine.features = local_features();
     std::string boot = read_boot_id();
     strncpy(mine.boot_id, boot.c_str(), sizeof(mine.boot_id) - 1);
     mine.probe_addr = reinterpret_cast<uint64_t>(&probe_val_);
@@ -531,6 +657,9 @@ class EmuQp : public Qp {
       setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
       return;
     }
+
+    // Wire-changing features require agreement from both ends.
+    features_ = mine.features & peer.features;
 
     // Same process is decided by the random token, never by pid (pids
     // are namespace-relative). An unreadable boot_id fails CLOSED:
@@ -635,11 +764,9 @@ class EmuQp : public Qp {
     // Unexpected message: materialize it now. In desc mode the
     // sender's buffer is only promised stable until its completion,
     // which our ack produces — so the copy must happen before the ack.
-    // The bounce buffer's size is wire-controlled: cap it so a corrupt
-    // peer can't bad_alloc the progress thread (legit unexpected
-    // messages are ring chunks, MBs at most); an oversized frame kills
-    // this QP only — RC flush semantics, not process death.
-    constexpr uint64_t kMaxUnexpectedBytes = 1ull << 30;
+    // The bounce buffer's size is wire-controlled: cap it (an
+    // oversized frame kills this QP only — RC flush semantics, not
+    // process death).
     if (h.len > kMaxUnexpectedBytes) return false;
     std::vector<char> buf(h.len);
     bool ok;
@@ -662,7 +789,10 @@ class EmuQp : public Qp {
         recvs_.pop_front();
         have2 = true;
       } else if (ok) {
-        unexpected_.push_back(std::move(buf));
+        Unexpected u;
+        u.payload = std::move(buf);
+        u.len = h.len;
+        unexpected_.push_back(std::move(u));
       }
     }
     if (have2) {
@@ -672,6 +802,43 @@ class EmuQp : public Qp {
         push_wc({r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
     }
     return sent;
+  }
+
+  // OP_SEND_FB / OP_SEND_FB_DESC: fold into the matched recv_reduce
+  // buffer and return the folded result to the sender — via a direct
+  // CMA write-back (desc) or as the ack's payload (stream). Ack
+  // before local completion, as everywhere; if no recv is posted yet
+  // the ACK MUST WAIT for the fold, so the message is stashed and
+  // resolved at post_recv_reduce time. Returns false on connection
+  // loss.
+  bool handle_foldback_inbound(const FrameHdr &h, bool desc) {
+    if (h.len > kMaxUnexpectedBytes) return false;
+    Unexpected u;
+    u.fb = true;
+    u.desc = desc;
+    u.seq = h.seq;
+    u.src_va = h.aux;
+    u.len = h.len;
+    if (!desc) {
+      // Materialize the stream payload up front (it is consumed from
+      // the socket either way; a doomed fold still must drain it).
+      u.payload.resize(h.len);
+      if (h.len && !read_full(fd_, u.payload.data(), h.len)) return false;
+    }
+    PostedRecv r{};
+    bool have = false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (!recvs_.empty()) {
+        r = recvs_.front();
+        recvs_.pop_front();
+        have = true;
+      } else {
+        unexpected_.push_back(std::move(u));
+      }
+    }
+    if (have) return finish_foldback(r, u);
+    return true;
   }
 
   // Drain len payload bytes we cannot place (bad rkey etc.).
@@ -776,6 +943,38 @@ class EmuQp : public Qp {
           if (!handle_send_inbound(h, /*desc=*/true)) goto out;
           break;
         }
+        case OP_SEND_FB: {
+          if (!handle_foldback_inbound(h, /*desc=*/false)) goto out;
+          break;
+        }
+        case OP_SEND_FB_DESC: {
+          if (!cma_) goto out;
+          if (!handle_foldback_inbound(h, /*desc=*/true)) goto out;
+          break;
+        }
+        case OP_SEND_FB_ACK: {
+          // Stream-tier acks carry the folded result; land it over
+          // the pending send's source region (the in-place final).
+          char *dst = nullptr;
+          uint64_t want = 0;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            auto it = pending_.find(h.seq);
+            if (it != pending_.end()) {
+              dst = it->second.dst;
+              want = it->second.len;
+            }
+          }
+          if (h.len) {
+            if (h.status == TDR_WC_SUCCESS && dst && h.len == want) {
+              if (!read_full(fd_, dst, h.len)) goto out;
+            } else {
+              if (!drain(h.len)) goto out;
+            }
+          }
+          complete_pending(h.seq, h.status, nullptr, 0);
+          break;
+        }
         case OP_WRITE_ACK:
         case OP_SEND_ACK: {
           complete_pending(h.seq, h.status, nullptr, 0);
@@ -837,10 +1036,11 @@ class EmuQp : public Qp {
   std::thread progress_;
   std::atomic<bool> closing_{false};
 
-  // CMA tier state, fixed at handshake time.
+  // CMA tier state and negotiated features, fixed at handshake time.
   bool cma_ = false;
   pid_t peer_pid_ = -1;
   uint64_t probe_val_ = 0;
+  uint32_t features_ = 0;
 
   std::mutex send_mu_;  // serializes frame submission on the socket
 
@@ -849,7 +1049,7 @@ class EmuQp : public Qp {
   std::deque<tdr_wc> cq_;
   std::unordered_map<uint64_t, PendingOp> pending_;
   std::deque<PostedRecv> recvs_;
-  std::deque<std::vector<char>> unexpected_;
+  std::deque<Unexpected> unexpected_;
   uint64_t next_seq_ = 1;
   bool dead_ = false;
 };

@@ -305,6 +305,175 @@ struct StepPipe {
 
 }  // namespace
 
+namespace {
+
+// World-2 fused exchange: reduce-scatter and all-gather overlapped
+// chunk-wise. The generic schedule runs the two phases back to back;
+// for world=2 they use OPPOSITE directions of the two neighbor QPs
+// (phase 1 rides right→peer-left, phase 2 rides left→peer-right), so
+// there is no FIFO-matching conflict in running them concurrently:
+// the moment chunk c of my reduce segment is folded, the reduced
+// chunk is sent back while the next inbound chunk is still in flight.
+// Besides hiding the phase-2 latency behind phase 1, the return
+// transfer reads bytes the fold JUST wrote — LLC-hot instead of a
+// DRAM re-read, which on a bandwidth-bound host is the difference
+// between 5 and 6 passes over the buffer per allreduce.
+//
+// Requires reduce-on-receive (folds happen in the transport's
+// progress engine as chunks arrive) and distinct left/right QPs; the
+// caller falls back to the generic two-phase pipeline otherwise.
+struct FusedTwo {
+  tdr_ring *r;
+  tdr_mr *dmr;
+  int dtype, red_op;
+
+  size_t chunk;
+  // A = the segment this rank sends out first and receives back
+  // reduced; B = the segment it folds locally and returns.
+  size_t a_off, a_len, b_off, b_len;
+  size_t n_a = 0, n_b = 0;
+  // Foldback mode: A chunks go out as fold-and-write-back sends whose
+  // acks mean "the reduced final landed in place" — the two return
+  // streams (reduced-B sends, A-final recvs) disappear entirely, and
+  // the fold+return is one pass in the peer's progress engine.
+  bool use_fb = false;
+
+  size_t posted_rB = 0, done_rB = 0;   // left in: B chunks to fold
+  size_t posted_sB = 0, acked_sB = 0;  // left out: reduced B chunks
+  size_t posted_sA = 0, acked_sA = 0;  // right out: A chunks
+  size_t posted_rA = 0, done_rA = 0;   // right in: reduced A chunks
+
+  static size_t nchunks(size_t len, size_t chunk) {
+    return len ? (len + chunk - 1) / chunk : 0;
+  }
+  size_t clen(size_t total, size_t i) const {
+    return std::min(chunk, total - i * chunk);
+  }
+
+  int post_recv_b(size_t i) {
+    return tdr_post_recv_reduce(r->left, dmr, b_off + i * chunk,
+                                clen(b_len, i), dtype, red_op, kWrRecv | i);
+  }
+  int post_recv_a(size_t i) {
+    return tdr_post_recv(r->right, dmr, a_off + i * chunk, clen(a_len, i),
+                         kWrRecv | i);
+  }
+
+  // Drain one QP's completions; `left` routes them to the B streams
+  // (fold + reduced-send acks), else to the A streams.
+  int drain(bool left, int timeout_ms) {
+    tdr_wc wc[16];
+    tdr_qp *qp = left ? r->left : r->right;
+    int n = tdr_poll(qp, wc, 16, timeout_ms);
+    if (n < 0) return -1;
+    for (int i = 0; i < n; i++) {
+      if (wc[i].status != TDR_WC_SUCCESS) {
+        tdr::set_error("ring(fused2): completion error status " +
+                       std::to_string(wc[i].status));
+        return -1;
+      }
+      uint64_t kind = wc[i].wr_id & kWrKindMask;
+      size_t idx = wc[i].wr_id & ~kWrKindMask;
+      if (kind == kWrSend) {
+        (left ? acked_sB : acked_sA)++;
+      } else if (kind == kWrRecv) {
+        size_t &done = left ? done_rB : done_rA;
+        if (idx != done) {
+          tdr::set_error("ring(fused2): out-of-order recv completion");
+          return -1;
+        }
+        done++;
+        size_t &posted = left ? posted_rB : posted_rA;
+        size_t total = left ? n_b : n_a;
+        if (posted < total) {
+          if ((left ? post_recv_b(posted) : post_recv_a(posted)) != 0)
+            return -1;
+          posted++;
+        }
+      }
+    }
+    return n;
+  }
+
+  int run() {
+    // Pre-post the inbound streams deep: every target is a disjoint
+    // slice of the data MR (folds for B, final placement for A), so
+    // only the QP depth bounds the window. In foldback mode there is
+    // no A-final stream — the send ack carries that meaning.
+    for (; posted_rB < std::min(n_b, kMaxOutstanding); posted_rB++)
+      if (post_recv_b(posted_rB) != 0) return -1;
+    if (!use_fb)
+      for (; posted_rA < std::min(n_a, kMaxOutstanding); posted_rA++)
+        if (post_recv_a(posted_rA) != 0) return -1;
+    if (use_fb) done_rA = n_a;          // stream does not exist
+    const size_t need_sB = use_fb ? 0 : n_b;  // ditto
+
+    while (done_rB < n_b || acked_sB < need_sB || done_rA < n_a ||
+           acked_sA < n_a) {
+      bool progressed = false;
+      if (posted_sA < n_a && posted_sA - acked_sA < kMaxOutstanding) {
+        int rc = use_fb
+                     ? tdr_post_send_foldback(r->right, dmr,
+                                              a_off + posted_sA * chunk,
+                                              clen(a_len, posted_sA),
+                                              kWrSend | posted_sA)
+                     : tdr_post_send(r->right, dmr, a_off + posted_sA * chunk,
+                                     clen(a_len, posted_sA),
+                                     kWrSend | posted_sA);
+        if (rc != 0) return -1;
+        posted_sA++;
+        progressed = true;
+      }
+      // Non-foldback: return a reduced B chunk the moment its fold
+      // completes (cache-hot). Foldback mode returns it inside the
+      // fold itself.
+      if (!use_fb && posted_sB < done_rB &&
+          posted_sB - acked_sB < kMaxOutstanding) {
+        if (tdr_post_send(r->left, dmr, b_off + posted_sB * chunk,
+                          clen(b_len, posted_sB), kWrSend | posted_sB) != 0)
+          return -1;
+        posted_sB++;
+        progressed = true;
+      }
+      int nl = drain(true, 0);
+      if (nl < 0) return -1;
+      int nr = drain(false, 0);
+      if (nr < 0) return -1;
+      // Reaped completions count as progress: the loop condition must
+      // be re-evaluated before blocking, or the final completion can
+      // be consumed right here and the blocking poll waits on nothing.
+      if (nl > 0 || nr > 0) progressed = true;
+      if (!progressed) {
+        // Nothing postable: block on the side that still owes us
+        // completions (progress threads keep both moving regardless).
+        bool left_owes =
+            done_rB < n_b || acked_sB < posted_sB;
+        int n = drain(left_owes, 30000);
+        if (n < 0) return -1;
+        if (n == 0) {
+          tdr::set_error(
+              "ring(fused2): poll timeout (rB " + std::to_string(done_rB) +
+              "/" + std::to_string(n_b) + " sB " + std::to_string(acked_sB) +
+              "/" + std::to_string(posted_sB) + " rA " +
+              std::to_string(done_rA) + "/" + std::to_string(n_a) + " sA " +
+              std::to_string(acked_sA) + "/" + std::to_string(posted_sA) +
+              ")");
+          return -1;
+        }
+      }
+    }
+    return 0;
+  }
+};
+
+bool fused2_disabled() {
+  const char *env = getenv("TDR_NO_FUSED2");
+  return env && *env && *env != '0';
+}
+
+
+}  // namespace
+
 int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                        int red_op) {
   if (!r || !data) {
@@ -342,6 +511,29 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
     }
   } guard{dmr, owned};
   (void)guard;
+
+  // World-2 fast path: phases overlapped chunk-wise (see FusedTwo).
+  // Segment roles per the generic schedule below at world=2: this rank
+  // sends seg[rank] out first (phase-1 send) and folds seg[1-rank].
+  if (world == 2 && r->left != r->right &&
+      tdr_qp_has_recv_reduce(r->left) && !fused2_disabled()) {
+    FusedTwo f{r,
+               dmr,
+               dtype,
+               red_op,
+               r->chunk,
+               seg_off[r->rank],
+               seg_len[r->rank],
+               seg_off[1 - r->rank],
+               seg_len[1 - r->rank]};
+    f.n_a = FusedTwo::nchunks(f.a_len, f.chunk);
+    f.n_b = FusedTwo::nchunks(f.b_len, f.chunk);
+    // Foldback is a NEGOTIATED capability (both ends advertised it in
+    // the QP handshake, where TDR_NO_FOLDBACK/TDR_NO_FUSED2 act), so
+    // both ranks take the same branch here by construction.
+    f.use_fb = tdr_qp_has_send_foldback(r->right);
+    return f.run();
+  }
 
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};
 

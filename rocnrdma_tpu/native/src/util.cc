@@ -102,6 +102,97 @@ void reduce_bf16(uint16_t *dst, const uint16_t *src, size_t n, int op) {
 
 }  // namespace
 
+namespace {
+
+// Fused exchange fold: r = d op s, stored to BOTH d and s in the same
+// pass (the element was just loaded, so both lines are cache-resident
+// and the second store costs no extra DRAM read). Both sides end with
+// bit-identical results — for bf16 the rounding happens once.
+template <typename T>
+void reduce2_typed(T *d, T *s, size_t n, int op) {
+  switch (op) {
+    case TDR_RED_SUM:
+      for (size_t i = 0; i < n; i++) {
+        T v = d[i] + s[i];
+        d[i] = v;
+        s[i] = v;
+      }
+      break;
+    case TDR_RED_MAX:
+      for (size_t i = 0; i < n; i++) {
+        T v = s[i] > d[i] ? s[i] : d[i];
+        d[i] = v;
+        s[i] = v;
+      }
+      break;
+    case TDR_RED_MIN:
+      for (size_t i = 0; i < n; i++) {
+        T v = s[i] < d[i] ? s[i] : d[i];
+        d[i] = v;
+        s[i] = v;
+      }
+      break;
+  }
+}
+
+template <int kOp>
+void reduce2_bf16_op(uint16_t *d, uint16_t *s, size_t n) {
+  for (size_t i = 0; i < n; i++) {
+    float a = bf16_to_f32(d[i]), b = bf16_to_f32(s[i]);
+    float r;
+    if (kOp == TDR_RED_SUM)
+      r = a + b;
+    else if (kOp == TDR_RED_MAX)
+      r = b > a ? b : a;
+    else
+      r = b < a ? b : a;
+    uint16_t v = f32_to_bf16(r);
+    d[i] = v;
+    s[i] = v;
+  }
+}
+
+void reduce2_bf16(uint16_t *d, uint16_t *s, size_t n, int op) {
+  switch (op) {
+    case TDR_RED_SUM:
+      reduce2_bf16_op<TDR_RED_SUM>(d, s, n);
+      break;
+    case TDR_RED_MAX:
+      reduce2_bf16_op<TDR_RED_MAX>(d, s, n);
+      break;
+    case TDR_RED_MIN:
+      reduce2_bf16_op<TDR_RED_MIN>(d, s, n);
+      break;
+  }
+}
+
+}  // namespace
+
+void reduce2_any(void *dst, void *src, size_t n, int dt, int op) {
+  switch (dt) {
+    case TDR_DT_F32:
+      reduce2_typed(static_cast<float *>(dst), static_cast<float *>(src), n,
+                    op);
+      break;
+    case TDR_DT_F64:
+      reduce2_typed(static_cast<double *>(dst), static_cast<double *>(src), n,
+                    op);
+      break;
+    case TDR_DT_I32:
+      reduce2_typed(static_cast<int32_t *>(dst), static_cast<int32_t *>(src),
+                    n, op);
+      break;
+    case TDR_DT_I64:
+      reduce2_typed(static_cast<int64_t *>(dst), static_cast<int64_t *>(src),
+                    n, op);
+      break;
+    case TDR_DT_BF16:
+      reduce2_bf16(static_cast<uint16_t *>(dst),
+                   static_cast<uint16_t *>(src), n, op);
+      break;
+  }
+}
+
 void reduce_any(void *dst, const void *src, size_t n, int dt, int op) {
   switch (dt) {
     case TDR_DT_F32:

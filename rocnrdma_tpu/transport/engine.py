@@ -130,6 +130,15 @@ def _declare(lib: ctypes.CDLL) -> None:
         P, P, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_uint64,
     ]
     lib.tdr_post_recv.argtypes = lib.tdr_post_send.argtypes
+    lib.tdr_post_recv_reduce.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64,
+    ]
+    lib.tdr_qp_has_recv_reduce.restype = ctypes.c_int
+    lib.tdr_qp_has_recv_reduce.argtypes = [P]
+    lib.tdr_post_send_foldback.argtypes = lib.tdr_post_send.argtypes
+    lib.tdr_qp_has_send_foldback.restype = ctypes.c_int
+    lib.tdr_qp_has_send_foldback.argtypes = [P]
     lib.tdr_poll.restype = ctypes.c_int
     lib.tdr_poll.argtypes = [P, ctypes.POINTER(Wc), ctypes.c_int, ctypes.c_int]
     lib.tdr_ring_create.restype = P
@@ -262,6 +271,39 @@ class QueuePair:
                                    _live(mr._h, "post_recv mr"), loff,
                                    maxlen, wr_id)
         _check(rc == 0, "post_recv")
+
+    def post_recv_reduce(self, mr: MemoryRegion, loff: int, maxlen: int,
+                         dtype: int, red_op: int = RED_SUM,
+                         wr_id: int = 0) -> None:
+        """Fused reduce-on-receive: the inbound SEND payload is folded
+        into the buffer (dst op= src) by the progress engine —
+        capability-gated (``has_recv_reduce``)."""
+        rc = _load().tdr_post_recv_reduce(
+            _live(self._h, "post_recv_reduce"),
+            _live(mr._h, "post_recv_reduce mr"), loff, maxlen, dtype,
+            red_op, wr_id)
+        _check(rc == 0, "post_recv_reduce")
+
+    def post_send_foldback(self, mr: MemoryRegion, loff: int, length: int,
+                           wr_id: int = 0) -> None:
+        """Fold-and-write-back send: the peer folds this payload into
+        its matched reduce-recv buffer and the folded result lands
+        back in place over [loff, loff+length); the send completion
+        means the exchange is finished on both sides."""
+        rc = _load().tdr_post_send_foldback(
+            _live(self._h, "post_send_foldback"),
+            _live(mr._h, "post_send_foldback mr"), loff, length, wr_id)
+        _check(rc == 0, "post_send_foldback")
+
+    @property
+    def has_recv_reduce(self) -> bool:
+        return bool(_load().tdr_qp_has_recv_reduce(
+            _live(self._h, "has_recv_reduce")))
+
+    @property
+    def has_send_foldback(self) -> bool:
+        return bool(_load().tdr_qp_has_send_foldback(
+            _live(self._h, "has_send_foldback")))
 
     def poll(self, max_wc: int = 16, timeout_ms: int = -1) -> List[Completion]:
         arr = (Wc * max_wc)()

@@ -1,0 +1,196 @@
+"""Fold-and-write-back exchange (post_send_foldback / post_recv_reduce).
+
+The fused op behind the world-2 allreduce fast path: the receiver
+folds the inbound payload into its buffer and the folded result lands
+back in place over the sender's source, so one posted op replaces the
+whole all-gather return phase. These tests pin down the op's contract
+at the engine level and the ring-level equivalence of every schedule
+(generic two-phase, fused two-stream, fused foldback) across tiers
+(same-process CMA and the TCP stream tier).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.collectives.world import local_worlds
+from rocnrdma_tpu.transport.engine import (
+    DT_F32, Engine, RED_SUM, WC_LOC_ACCESS_ERR, loopback_pair)
+
+PORT = 23100
+
+
+def _pair(engine, port):
+    return loopback_pair(engine, port)
+
+
+@pytest.fixture
+def loop():
+    e = Engine("emu")
+    a, b = _pair(e, PORT + (os.getpid() % 500))
+    yield e, a, b
+    a.close()
+    b.close()
+    e.close()
+
+
+def test_foldback_exchange_both_sides_identical(loop):
+    e, a, b = loop
+    x = np.arange(1000, dtype=np.float32)
+    y = np.arange(1000, dtype=np.float32) * 3.0
+    want = x + y
+    with e.reg_mr(x) as xmr, e.reg_mr(y) as ymr:
+        b.post_recv_reduce(ymr, 0, y.nbytes, DT_F32, RED_SUM, wr_id=7)
+        a.post_send_foldback(xmr, 0, x.nbytes, wr_id=8)
+        assert b.wait(7, 10000).ok
+        assert a.wait(8, 10000).ok
+        np.testing.assert_array_equal(y, want)   # receiver folded
+        np.testing.assert_array_equal(x, want)   # sender got it back
+
+
+def test_foldback_before_recv_posted_defers_ack(loop):
+    e, a, b = loop
+    x = np.ones(512, dtype=np.float32)
+    y = np.full(512, 2.0, dtype=np.float32)
+    with e.reg_mr(x) as xmr, e.reg_mr(y) as ymr:
+        a.post_send_foldback(xmr, 0, x.nbytes, wr_id=1)
+        # The ack must wait for the fold: no completion until the
+        # peer posts its reduce recv.
+        time.sleep(0.2)
+        assert a.poll(1, timeout_ms=0) == []
+        b.post_recv_reduce(ymr, 0, y.nbytes, DT_F32, RED_SUM, wr_id=2)
+        assert b.wait(2, 10000).ok
+        assert a.wait(1, 10000).ok
+        np.testing.assert_array_equal(x, np.full(512, 3.0, np.float32))
+        np.testing.assert_array_equal(y, np.full(512, 3.0, np.float32))
+
+
+def test_foldback_into_plain_recv_errors_both_sides(loop):
+    e, a, b = loop
+    x = np.ones(64, dtype=np.float32)
+    y = np.zeros(64, dtype=np.float32)
+    with e.reg_mr(x) as xmr, e.reg_mr(y) as ymr:
+        b.post_recv(ymr, 0, y.nbytes, wr_id=1)   # NOT a reduce recv
+        a.post_send_foldback(xmr, 0, x.nbytes, wr_id=2)
+        wb = b.wait(1, 10000)
+        wa = a.wait(2, 10000)
+        assert wb.status == WC_LOC_ACCESS_ERR
+        assert not wa.ok
+        np.testing.assert_array_equal(y, np.zeros(64, np.float32))
+
+
+def _ring_allreduce_result(env, port, count=100003, world=2):
+    """Run a world-rank in-process allreduce under env overrides and
+    return the per-rank buffers."""
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        worlds = local_worlds(world, port)
+        rng = np.random.default_rng(42)
+        bufs = [rng.standard_normal(count).astype(np.float32)
+                for _ in range(world)]
+        ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for w in worlds:
+            w.close()
+        return bufs
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_ring_schedules_agree():
+    """Generic, fused, and foldback schedules produce the same sums."""
+    base = _ring_allreduce_result({"TDR_NO_FUSED2": "1"}, 23600)
+    fused = _ring_allreduce_result(
+        {"TDR_NO_FUSED2": "", "TDR_NO_FOLDBACK": "1"}, 23610)
+    fb = _ring_allreduce_result({}, 23620)
+    want = None
+    for bufs in (base, fused, fb):
+        np.testing.assert_allclose(bufs[0], bufs[1], rtol=0, atol=0)
+        if want is None:
+            want = bufs[0]
+        np.testing.assert_allclose(bufs[0], want, rtol=1e-5, atol=1e-6)
+
+
+def test_ring_foldback_stream_tier():
+    """Foldback over the TCP stream tier (CMA disabled): the folded
+    result rides back on the ack payload. The buffer deliberately
+    exceeds the socket buffers and the ring chunk so blocking payload
+    writes interleave with inbound ack payloads on both connections."""
+    bufs = _ring_allreduce_result({"TDR_NO_CMA": "1"}, 23630,
+                                  count=6 * (1 << 20) + 13)
+    np.testing.assert_allclose(bufs[0], bufs[1], rtol=0, atol=0)
+
+
+def test_foldback_env_mismatch_negotiates_down():
+    """A rank with TDR_NO_FOLDBACK set must not wedge a peer without
+    it: the capability is negotiated in the QP handshake, so a
+    mismatched pair degrades to the wire-compatible schedule and the
+    allreduce still completes correctly on both ranks."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    script = """
+import os
+import socket
+
+import numpy as np
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+base = s.getsockname()[1]; s.close()
+count = (4 << 20) // 4
+
+pid = os.fork()
+rank = 1 if pid == 0 else 0
+if rank == 1:
+    os.environ["TDR_NO_FOLDBACK"] = "1"   # only this rank opts out
+from rocnrdma_tpu.collectives.world import RingWorld
+from rocnrdma_tpu.transport.engine import Engine
+
+w = RingWorld(Engine("emu"), rank, 2, base + 100)
+buf = np.full(count, float(rank + 1), dtype=np.float32)
+w.allreduce(buf)
+ok = bool(np.all(buf == 3.0))
+w.close()
+if pid == 0:
+    os._exit(0 if ok else 1)
+assert ok
+_, status = os.waitpid(pid, 0)
+assert os.waitstatus_to_exitcode(status) == 0
+print("OK")
+"""
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+
+
+def test_foldback_bf16_bit_identical(loop):
+    e, a, b = loop
+    import ml_dtypes
+
+    x = (np.arange(333) % 7).astype(ml_dtypes.bfloat16)
+    y = (np.arange(333) % 5).astype(ml_dtypes.bfloat16)
+    from rocnrdma_tpu.transport.engine import DT_BF16
+
+    with e.reg_mr(x) as xmr, e.reg_mr(y) as ymr:
+        b.post_recv_reduce(ymr, 0, y.nbytes, DT_BF16, RED_SUM, wr_id=1)
+        a.post_send_foldback(xmr, 0, x.nbytes, wr_id=2)
+        assert b.wait(1, 10000).ok
+        assert a.wait(2, 10000).ok
+    # One rounding, both sides bit-identical.
+    np.testing.assert_array_equal(x.view(np.uint16), y.view(np.uint16))
