@@ -112,7 +112,13 @@ class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, freqs):
+    def __call__(self, x, freqs, cache=None, pos=None):
+        """Training/no-cache: x is the full (B, S, D) sequence, causal
+        attention, returns (out, None). Decode: ``cache`` holds per-
+        layer K/V of shape (B, n_kv, max_seq, hd) and ``pos`` is the
+        absolute position of x's first token; K/V are written at pos
+        and attention runs over the cache with a static-shape mask —
+        the standard jit-friendly incremental decode."""
         cfg = self.cfg
         b, s, _ = x.shape
         hd = cfg.head_dim
@@ -125,13 +131,41 @@ class Attention(nn.Module):
         q = q.reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, s, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, s, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-        q = apply_rope(q, freqs[:s])
-        k = apply_rope(k, freqs[:s])
-        o = attention(q, k, v, causal=True,
-                      use_pallas=cfg.use_pallas_attention,
-                      interpret=cfg.pallas_interpret)
+        if cache is None:
+            q = apply_rope(q, freqs[:s])
+            k = apply_rope(k, freqs[:s])
+            o = attention(q, k, v, causal=True,
+                          use_pallas=cfg.use_pallas_attention,
+                          interpret=cfg.pallas_interpret)
+            o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
+            return dense(cfg.d_model, "wo")(o), None
+
+        fr = jax.lax.dynamic_slice_in_dim(freqs, pos, s)
+        q = apply_rope(q, fr)
+        k = apply_rope(k, fr)
+        k_all = jax.lax.dynamic_update_slice(
+            cache["k"], k.astype(cache["k"].dtype), (0, 0, pos, 0))
+        v_all = jax.lax.dynamic_update_slice(
+            cache["v"], v.astype(cache["v"].dtype), (0, 0, pos, 0))
+        # Grouped-query attention against the cache without ever
+        # materializing a head-repeated (or f32-widened) copy of it:
+        # fold the group axis into the query tensor and let the einsum
+        # accumulate in f32 (preferred_element_type), as the training
+        # kernels do.
+        rep = cfg.n_heads // cfg.n_kv_heads
+        qg = q.reshape(b, cfg.n_kv_heads, rep, s, hd)
+        scores = jnp.einsum(
+            "bgrqd,bgkd->bgrqk", qg, k_all,
+            preferred_element_type=jnp.float32) / (hd ** 0.5)
+        q_pos = pos + jnp.arange(s)
+        visible = jnp.arange(cache["k"].shape[2])[None, :] <= q_pos[:, None]
+        scores = jnp.where(visible[None, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bgrqk,bgkd->bgrqd", probs.astype(cfg.dtype), v_all,
+                       preferred_element_type=jnp.float32)
+        o = o.astype(cfg.dtype).reshape(b, cfg.n_heads, s, hd)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
-        return dense(cfg.d_model, "wo")(o)
+        return dense(cfg.d_model, "wo")(o), {"k": k_all, "v": v_all}
 
 
 class MLP(nn.Module):
@@ -152,20 +186,25 @@ class Block(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, freqs):
-        x = x + Attention(self.cfg, name="attn")(
-            RMSNorm(self.cfg, name="attn_norm")(x), freqs)
+    def __call__(self, x, freqs, cache=None, pos=None):
+        attn_out, new_cache = Attention(self.cfg, name="attn")(
+            RMSNorm(self.cfg, name="attn_norm")(x), freqs, cache, pos)
+        x = x + attn_out
         x = x + MLP(self.cfg, name="mlp")(
             RMSNorm(self.cfg, name="mlp_norm")(x))
-        return x
+        return x, new_cache
 
 
 class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
-        """tokens: (B, S) int32 → logits (B, S, vocab) f32."""
+    def __call__(self, tokens: jnp.ndarray, cache=None, pos=None):
+        """tokens: (B, S) int32 → logits (B, S, vocab) f32.
+
+        With ``cache`` (from :func:`init_cache`) and ``pos``, runs in
+        incremental-decode mode and returns ``(logits, new_cache)``;
+        without, plain causal forward returning logits only."""
         cfg = self.cfg
         if tokens.shape[-1] > cfg.max_seq_len:
             raise ValueError(
@@ -176,12 +215,20 @@ class Llama(nn.Module):
                        name="embed")
         x = emb(tokens)
         freqs = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        new_cache = {} if cache is not None else None
         for i in range(cfg.n_layers):
-            x = Block(cfg, name=f"layer_{i}")(x, freqs)
+            layer_cache = cache[f"layer_{i}"] if cache is not None else None
+            x, lc = Block(cfg, name=f"layer_{i}")(x, freqs, layer_cache,
+                                                  pos)
+            if new_cache is not None:
+                new_cache[f"layer_{i}"] = lc
         x = RMSNorm(cfg, name="final_norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.dtype, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if cache is not None:
+            return logits, new_cache
+        return logits
 
 
 def make_model(config: "LlamaConfig | str", **overrides) -> Llama:
@@ -201,3 +248,92 @@ def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_seq: Optional[int] = None):
+    """Zeroed KV cache pytree: per layer, K/V of shape
+    (B, n_kv_heads, max_seq, head_dim) in the model dtype. Static
+    shapes — decode steps jit once and reuse the executable."""
+    s = max_seq or cfg.max_seq_len
+    shape = (batch, cfg.n_kv_heads, s, cfg.head_dim)
+    return {
+        f"layer_{i}": {
+            "k": jnp.zeros(shape, dtype=cfg.dtype),
+            "v": jnp.zeros(shape, dtype=cfg.dtype),
+        }
+        for i in range(cfg.n_layers)
+    }
+
+
+def generate(model: Llama, params, prompt: jnp.ndarray,
+             max_new_tokens: int, temperature: float = 0.0,
+             rng=None) -> jnp.ndarray:
+    """Autoregressive generation with an incremental KV cache.
+
+    prompt: (B, P) int32. Returns (B, max_new_tokens) int32. Greedy at
+    temperature 0, else categorical sampling. The whole loop — prefill
+    + lax.scan over decode steps — is one jitted computation with
+    static shapes; repeated calls with the same (P, max_new_tokens)
+    reuse the compiled executable.
+    """
+    cfg = model.cfg
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if total > cfg.max_seq_len:
+        raise ValueError(f"prompt+new = {total} exceeds "
+                         f"max_seq_len={cfg.max_seq_len}")
+    if max_new_tokens <= 0:
+        return jnp.zeros((b, 0), dtype=jnp.int32)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def pick(logits_last, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits_last / temperature, axis=-1).astype(jnp.int32)
+
+    # Memoize the jitted loop per (config, shapes, temperature) so
+    # repeated generate() calls reuse the compiled executable.
+    memo_key = (cfg, b, p, max_new_tokens, float(temperature))
+    cached = _GEN_CACHE.get(memo_key)
+    if cached is not None:
+        return cached(params, prompt, rng)
+
+    def run(params, prompt, rng):
+        # Cache sized to the smallest multiple of 128 covering the
+        # sequence (MXU/lane-friendly, bounds the masked-attention
+        # wastage for short prompts).
+        cache_len = min(cfg.max_seq_len, ((total + 127) // 128) * 128)
+        cache = init_cache(cfg, b, cache_len)
+        logits, cache = model.apply(params, prompt, cache=cache, pos=0)
+        rng, key = jax.random.split(rng)
+        first = pick(logits[:, -1], key)
+
+        def step(carry, _):
+            cache, tok, pos, rng = carry
+            logits, cache = model.apply(params, tok[:, None], cache=cache,
+                                        pos=pos)
+            rng, key = jax.random.split(rng)
+            nxt = pick(logits[:, -1], key)
+            return (cache, nxt, pos + 1, rng), nxt
+
+        if max_new_tokens == 1:
+            return first[:, None]
+        (_, _, _, _), rest = jax.lax.scan(
+            step, (cache, first, jnp.asarray(p, jnp.int32), rng), None,
+            length=max_new_tokens - 1)
+        return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+    jitted = jax.jit(run)
+    # Bounded FIFO: one executable per distinct shape tuple, evicted
+    # oldest-first so a serving loop with varying prompt lengths does
+    # not accumulate compiled programs without limit.
+    if len(_GEN_CACHE) >= _GEN_CACHE_MAX:
+        _GEN_CACHE.pop(next(iter(_GEN_CACHE)))
+    _GEN_CACHE[memo_key] = jitted
+    return jitted(params, prompt, rng)
+
+
+_GEN_CACHE: dict = {}
+_GEN_CACHE_MAX = 32

@@ -1,0 +1,86 @@
+"""KV-cache incremental decode (models.llama.generate)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rocnrdma_tpu.models.llama import (
+    generate, init_cache, init_params, make_model)
+
+
+def _tiny():
+    model = make_model("llama-tiny")
+    params = init_params(model, jax.random.PRNGKey(0))
+    return model, params
+
+
+def test_cached_prefill_matches_full_forward():
+    model, params = _tiny()
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 255, (2, 12)), jnp.int32)
+    full = model.apply(params, tokens)
+    cache = init_cache(model.cfg, 2, 64)
+    cached, _ = model.apply(params, tokens, cache=cache, pos=0)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(cached),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_incremental_decode_matches_full_forward():
+    """Feeding tokens one at a time through the cache must reproduce
+    the full-sequence forward at every position."""
+    model, params = _tiny()
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, 255, (1, 10)), jnp.int32)
+    full = model.apply(params, tokens)  # (1, 10, V)
+
+    cache = init_cache(model.cfg, 1, 64)
+    outs = []
+    for i in range(10):
+        logits, cache = model.apply(params, tokens[:, i:i + 1],
+                                    cache=cache, pos=i)
+        outs.append(np.asarray(logits[:, 0]))
+    inc = np.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), inc, rtol=2e-4, atol=2e-4)
+
+
+def test_generate_greedy_matches_no_cache_loop():
+    """generate() (prefill + scan decode) must emit exactly the tokens
+    a naive full-forward argmax loop emits."""
+    model, params = _tiny()
+    prompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, 255, (2, 5)), jnp.int32)
+    got = np.asarray(generate(model, params, prompt, max_new_tokens=6))
+
+    seq = prompt
+    want = []
+    for _ in range(6):
+        logits = model.apply(params, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        want.append(np.asarray(nxt))
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    want = np.stack(want, axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_sampled_shapes_and_determinism():
+    model, params = _tiny()
+    prompt = jnp.ones((1, 3), jnp.int32)
+    a = np.asarray(generate(model, params, prompt, 4, temperature=0.8,
+                            rng=jax.random.PRNGKey(7)))
+    b = np.asarray(generate(model, params, prompt, 4, temperature=0.8,
+                            rng=jax.random.PRNGKey(7)))
+    c = np.asarray(generate(model, params, prompt, 4, temperature=0.8,
+                            rng=jax.random.PRNGKey(8)))
+    assert a.shape == (1, 4)
+    np.testing.assert_array_equal(a, b)       # same key -> same tokens
+    assert a.dtype == np.int32
+    del c  # different keys may legitimately coincide on a tiny model
+
+
+def test_generate_respects_max_seq_len():
+    model, params = _tiny()
+    prompt = jnp.ones((1, 120), jnp.int32)
+    import pytest
+
+    with pytest.raises(ValueError):
+        generate(model, params, prompt, max_new_tokens=64)  # 184 > 128
