@@ -1,0 +1,134 @@
+"""HuggingFace Llama checkpoint import.
+
+The reference ships no models (SURVEY.md: it is a transport driver);
+the Llama family here is the BASELINE config-4 consumer, and real
+checkpoints are how a user actually runs it. This module maps a
+`transformers` Llama state dict (LlamaForCausalLM layout) onto this
+package's flax parameter tree.
+
+Conventions that make the mapping a pure transpose job:
+- torch ``nn.Linear.weight`` is (out, in); flax ``Dense`` kernel is
+  (in, out) → transpose every projection.
+- HF checkpoints use the rotate-half (GPT-NeoX-style) RoPE layout —
+  the same convention ``models.llama.apply_rope`` implements — so no
+  head-dim permutation is needed.
+- Head ordering is head-major in both (row block h covers
+  ``h*head_dim .. (h+1)*head_dim``).
+- ``tie_word_embeddings`` checkpoints have no ``lm_head.weight``; the
+  embedding matrix is reused.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from rocnrdma_tpu.models.llama import Llama, LlamaConfig
+
+
+def config_from_hf(hf_config: Any, name: str = "llama-hf",
+                   **overrides) -> LlamaConfig:
+    """LlamaConfig from a transformers LlamaConfig(-like) object."""
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit_hd = getattr(hf_config, "head_dim", None) or derived_hd
+    if explicit_hd != derived_hd:
+        raise ValueError(
+            f"unsupported checkpoint: explicit head_dim={explicit_hd} != "
+            f"hidden_size/num_heads={derived_hd} (this architecture "
+            "derives head_dim; width-pruned checkpoints need resizing)")
+    cfg = LlamaConfig(
+        name=name,
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads",
+                           hf_config.num_attention_heads),
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        norm_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+    )
+    if overrides:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like → numpy, via f32 so bf16/f16
+    checkpoint tensors (which numpy cannot represent) convert."""
+    if hasattr(t, "detach"):
+        import torch
+
+        return t.detach().to(torch.float32).cpu().numpy()
+    return np.asarray(t)
+
+
+def from_hf_state_dict(cfg: LlamaConfig,
+                       state: Mapping[str, Any]) -> Dict[str, Any]:
+    """Map an HF LlamaForCausalLM state dict to this package's flax
+    params pytree (``{"params": ...}``), cast to ``cfg.dtype``."""
+
+    def dense(key: str) -> Dict[str, jnp.ndarray]:
+        w = _np(state[key])
+        return {"kernel": jnp.asarray(w.T, dtype=cfg.dtype)}
+
+    def norm(key: str) -> Dict[str, jnp.ndarray]:
+        # Norm weights stay f32 (they are f32 params in the model).
+        return {"weight": jnp.asarray(_np(state[key]), dtype=jnp.float32)}
+
+    params: Dict[str, Any] = {
+        "embed": {
+            "embedding": jnp.asarray(
+                _np(state["model.embed_tokens.weight"]), dtype=cfg.dtype)
+        },
+        "final_norm": norm("model.norm.weight"),
+    }
+    if "lm_head.weight" in state:
+        params["lm_head"] = dense("lm_head.weight")
+    else:  # tied embeddings
+        params["lm_head"] = {
+            "kernel": jnp.asarray(
+                _np(state["model.embed_tokens.weight"]).T, dtype=cfg.dtype)
+        }
+    for i in range(cfg.n_layers):
+        hf = f"model.layers.{i}"
+        params[f"layer_{i}"] = {
+            "attn": {
+                "wq": dense(f"{hf}.self_attn.q_proj.weight"),
+                "wk": dense(f"{hf}.self_attn.k_proj.weight"),
+                "wv": dense(f"{hf}.self_attn.v_proj.weight"),
+                "wo": dense(f"{hf}.self_attn.o_proj.weight"),
+            },
+            "attn_norm": norm(f"{hf}.input_layernorm.weight"),
+            "mlp": {
+                "w_gate": dense(f"{hf}.mlp.gate_proj.weight"),
+                "w_up": dense(f"{hf}.mlp.up_proj.weight"),
+                "w_down": dense(f"{hf}.mlp.down_proj.weight"),
+            },
+            "mlp_norm": norm(f"{hf}.post_attention_layernorm.weight"),
+        }
+    return {"params": params}
+
+
+def from_hf_model(hf_model: Any, name: str = "llama-hf",
+                  **overrides) -> Tuple[Llama, Dict[str, Any]]:
+    """(model, params) from a live transformers LlamaForCausalLM."""
+    cfg = config_from_hf(hf_model.config, name=name, **overrides)
+    model = Llama(cfg)
+    params = from_hf_state_dict(cfg, hf_model.state_dict())
+    return model, params
+
+
+def from_hf_pretrained(path_or_repo: str, name: str = "llama-hf",
+                       **overrides) -> Tuple[Llama, Dict[str, Any]]:
+    """(model, params) from a local HF checkpoint directory (or hub id
+    where network access exists)."""
+    from transformers import AutoModelForCausalLM
+
+    hf = AutoModelForCausalLM.from_pretrained(path_or_repo)
+    return from_hf_model(hf, name=name, **overrides)

@@ -471,6 +471,117 @@ bool fused2_disabled() {
   return env && *env && *env != '0';
 }
 
+bool wavefront_disabled() {
+  const char *env = getenv("TDR_NO_WAVEFRONT");
+  return env && *env && *env != '0';
+}
+
+// ------------------------------------------------------------------
+// Wavefront ring (world > 2, reduce-on-receive engines): the classic
+// schedule is 2(world-1) steps separated by barriers — the link idles
+// while the last chunks of a step fold, and every step pays a full
+// drain. Here the WHOLE schedule is flattened into two lexicographic
+// (step, chunk) sequences — one of sends (right QP), one of receives
+// (left QP) — and chunks advance through steps independently behind a
+// sliding window. Correctness with FIFO recv matching holds because
+// both sides post strictly in schedule order and TCP preserves it;
+// the data dependency is exactly "send (t,c) needs recv (t-1,c)",
+// and send step t's segment IS recv step t-1's segment, so a single
+// monotone completed-receives counter encodes readiness.
+// ------------------------------------------------------------------
+struct WaveItem {
+  size_t off;
+  size_t len;
+  bool reduce;     // recv side: fold vs place
+  size_t dep = 0;  // send side: required done_recv count
+};
+
+struct Wavefront {
+  tdr_ring *r;
+  tdr_mr *dmr;
+  int dtype, red_op;
+  std::vector<WaveItem> sends, recvs;
+
+  size_t posted_s = 0, acked_s = 0, posted_r = 0, done_r = 0;
+
+  int post_send_item(size_t i) {
+    const WaveItem &it = sends[i];
+    return tdr_post_send(r->right, dmr, it.off, it.len, kWrSend | i);
+  }
+  int post_recv_item(size_t i) {
+    const WaveItem &it = recvs[i];
+    if (it.reduce)
+      return tdr_post_recv_reduce(r->left, dmr, it.off, it.len, dtype,
+                                  red_op, kWrRecv | i);
+    return tdr_post_recv(r->left, dmr, it.off, it.len, kWrRecv | i);
+  }
+
+  int drain(bool left, int timeout_ms) {
+    tdr_wc wc[16];
+    tdr_qp *qp = left ? r->left : r->right;
+    int n = tdr_poll(qp, wc, 16, timeout_ms);
+    if (n < 0) return -1;
+    for (int i = 0; i < n; i++) {
+      if (wc[i].status != TDR_WC_SUCCESS) {
+        tdr::set_error("ring(wave): completion error status " +
+                       std::to_string(wc[i].status));
+        return -1;
+      }
+      uint64_t kind = wc[i].wr_id & kWrKindMask;
+      size_t idx = wc[i].wr_id & ~kWrKindMask;
+      if (kind == kWrSend) {
+        acked_s++;
+      } else if (kind == kWrRecv) {
+        if (idx != done_r) {
+          tdr::set_error("ring(wave): out-of-order recv completion");
+          return -1;
+        }
+        done_r++;
+      }
+    }
+    return n;
+  }
+
+  int run() {
+    const size_t N = sends.size(), M = recvs.size();
+    while (acked_s < N || done_r < M) {
+      bool progressed = false;
+      // Keep the recv window deep (disjoint targets; FIFO-matched).
+      while (posted_r < M && posted_r - done_r < kMaxOutstanding) {
+        if (post_recv_item(posted_r) != 0) return -1;
+        posted_r++;
+        progressed = true;
+      }
+      // Post sends strictly in schedule order as their dependency
+      // (the same-segment recv of the previous step) completes.
+      while (posted_s < N && posted_s - acked_s < kMaxOutstanding &&
+             done_r >= sends[posted_s].dep) {
+        if (post_send_item(posted_s) != 0) return -1;
+        posted_s++;
+        progressed = true;
+      }
+      int nl = drain(true, 0);
+      if (nl < 0) return -1;
+      int nr = drain(false, 0);
+      if (nr < 0) return -1;
+      if (nl > 0 || nr > 0) progressed = true;
+      if (!progressed) {
+        bool left_owes = done_r < M;
+        int n = drain(left_owes, 30000);
+        if (n < 0) return -1;
+        if (n == 0) {
+          tdr::set_error("ring(wave): poll timeout (s " +
+                         std::to_string(acked_s) + "/" + std::to_string(N) +
+                         " r " + std::to_string(done_r) + "/" +
+                         std::to_string(M) + ")");
+          return -1;
+        }
+      }
+    }
+    return 0;
+  }
+};
+
 
 }  // namespace
 
@@ -533,6 +644,57 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
     // both ranks take the same branch here by construction.
     f.use_fb = tdr_qp_has_send_foldback(r->right);
     return f.run();
+  }
+
+  // General wavefront path: the full 2(world-1)-step schedule
+  // flattened into windowed lexicographic send/recv streams (see
+  // Wavefront above). Needs reduce-on-receive (folds land in the data
+  // MR from the progress engine) and distinct neighbor QPs.
+  if (r->left != r->right && tdr_qp_has_recv_reduce(r->left) &&
+      !wavefront_disabled()) {
+    const size_t chunk = r->chunk;
+    auto nch = [&](size_t len) {
+      return len ? (len + chunk - 1) / chunk : size_t(0);
+    };
+    auto clen = [&](size_t total, size_t c) {
+      return std::min(chunk, total - c * chunk);
+    };
+    const int steps = 2 * (world - 1);
+    auto segs_at = [&](int t, int *send_seg, int *recv_seg) {
+      if (t < world - 1) {  // reduce-scatter
+        *send_seg = ((r->rank - t) % world + world) % world;
+        *recv_seg = ((r->rank - t - 1) % world + world) % world;
+      } else {  // all-gather
+        int s2 = t - (world - 1);
+        *send_seg = ((r->rank + 1 - s2) % world + world) % world;
+        *recv_seg = ((r->rank - s2) % world + world) % world;
+      }
+    };
+    Wavefront wf{r, dmr, dtype, red_op, {}, {}};
+    std::vector<size_t> rprefix(steps + 1, 0);
+    for (int t = 0; t < steps; t++) {
+      int ss, rs;
+      segs_at(t, &ss, &rs);
+      rprefix[t + 1] = rprefix[t] + nch(seg_len[rs]);
+    }
+    for (int t = 0; t < steps; t++) {
+      int ss, rs;
+      segs_at(t, &ss, &rs);
+      const bool fold = t < world - 1;
+      for (size_t c = 0; c < nch(seg_len[ss]); c++) {
+        WaveItem it{seg_off[ss] + c * chunk, clen(seg_len[ss], c), false,
+                    0};
+        // send (t,c) forwards the bytes recv (t-1,c) produced —
+        // send_seg(t) IS recv_seg(t-1) — so its dependency is that
+        // many completed receives.
+        if (t > 0) it.dep = rprefix[t - 1] + c + 1;
+        wf.sends.push_back(it);
+      }
+      for (size_t c = 0; c < nch(seg_len[rs]); c++)
+        wf.recvs.push_back({seg_off[rs] + c * chunk, clen(seg_len[rs], c),
+                            fold, 0});
+    }
+    return wf.run();
   }
 
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};

@@ -1,0 +1,117 @@
+"""tdr_allreduce — cross-host ring-allreduce benchmark (config 3).
+
+The collective-level counterpart of ``tools.perf``: brings up an
+N-rank ring over the transport and measures allreduce bus bandwidth
+(the BASELINE.md config-3 metric; 2*(world-1)/world of the buffer
+crosses each rank's link per op).
+
+Single machine, all ranks in one process (threads):
+
+    python -m rocnrdma_tpu.tools.allreduce --world 2 --bytes 1G
+
+One process per host (run on every host, same order of --peers):
+
+    python -m rocnrdma_tpu.tools.allreduce --rank 0 --world 2 \\
+        --peers hostA,hostB --bytes 1G --iters 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+from rocnrdma_tpu.tools.perf import parse_sizes
+
+
+def run_rank(world_obj, count: int, dtype, iters: int, barrier=None):
+    buf = np.ones(count, dtype=dtype)
+    world_obj.ring.register_buffer(buf)
+    world_obj.allreduce(buf)  # warmup (+ peers' MR setup)
+    if barrier is not None:
+        barrier.wait()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        world_obj.allreduce(buf)
+    dt = (time.perf_counter() - t0) / iters
+    world_obj.ring.unregister_buffer(buf)
+    return dt
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="tdr_allreduce", description=__doc__)
+    ap.add_argument("--rank", type=int, default=None,
+                    help="this host's rank; omit for in-process demo")
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--peers", default=None,
+                    help="comma-separated rank hosts (default localhost)")
+    ap.add_argument("--port", type=int, default=18700)
+    ap.add_argument("--bytes", default="1G")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "float64", "int32", "int64",
+                             "bfloat16"])
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--engine", default=None)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    from rocnrdma_tpu.collectives.world import RingWorld, local_worlds
+    from rocnrdma_tpu.transport.engine import Engine
+    from rocnrdma_tpu.utils.config import get_config
+
+    if args.dtype == "bfloat16":
+        import ml_dtypes
+
+        dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        dtype = np.dtype(args.dtype)
+    sizes = parse_sizes(args.bytes)
+    if len(sizes) != 1:
+        ap.error("--bytes takes a single size here (e.g. 1G); use "
+                 "tools.perf for 'lo:hi' sweeps")
+    count = max(1, sizes[0] // dtype.itemsize)
+    spec = args.engine or get_config().engine
+    world = args.world
+
+    if args.rank is None:
+        worlds = local_worlds(world, args.port, spec)
+        barrier = threading.Barrier(world)
+        out = [0.0] * world
+
+        def go(r):
+            out[r] = run_rank(worlds[r], count, dtype, args.iters, barrier)
+
+        ts = [threading.Thread(target=go, args=(r,)) for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = max(out)
+        for w in worlds:
+            w.close()
+    else:
+        peers = args.peers.split(",") if args.peers else None
+        w = RingWorld(Engine(spec), args.rank, world, args.port,
+                      peers=peers)
+        dt = run_rank(w, count, dtype, args.iters)
+        w.close()
+
+    payload = count * dtype.itemsize
+    bus = payload * 2 * (world - 1) / world / dt / 1e9
+    result = {"world": world, "bytes": payload, "dtype": args.dtype,
+              "iters": args.iters, "sec_per_op": round(dt, 4),
+              "bus_GBps": round(bus, 3)}
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(f"allreduce {payload} B x{world} ranks: {dt*1e3:.1f} ms/op, "
+              f"bus {bus:.2f} GB/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

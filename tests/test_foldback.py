@@ -109,15 +109,26 @@ def _ring_allreduce_result(env, port, count=100003, world=2):
                 os.environ[k] = v
 
 
-def test_ring_schedules_agree():
-    """Generic, fused, and foldback schedules produce the same sums."""
-    base = _ring_allreduce_result({"TDR_NO_FUSED2": "1"}, 23600)
-    fused = _ring_allreduce_result(
-        {"TDR_NO_FUSED2": "", "TDR_NO_FOLDBACK": "1"}, 23610)
-    fb = _ring_allreduce_result({}, 23620)
+@pytest.mark.parametrize("world", [2, 3])
+def test_ring_schedules_agree(world):
+    """Generic step-barrier, wavefront, fused-two, and foldback
+    schedules all produce the same sums."""
+    port = 23600 + world * 40
+    generic = _ring_allreduce_result(
+        {"TDR_NO_FUSED2": "1", "TDR_NO_WAVEFRONT": "1"}, port, world=world)
+    wave = _ring_allreduce_result(
+        {"TDR_NO_FUSED2": "1", "TDR_NO_WAVEFRONT": ""}, port + 10,
+        world=world)
+    variants = [generic, wave]
+    if world == 2:  # FusedTwo/foldback only engage at world == 2
+        variants.append(_ring_allreduce_result(
+            {"TDR_NO_FUSED2": "", "TDR_NO_FOLDBACK": "1",
+             "TDR_NO_WAVEFRONT": "1"}, port + 20, world=world))
+        variants.append(_ring_allreduce_result({}, port + 30, world=world))
     want = None
-    for bufs in (base, fused, fb):
-        np.testing.assert_allclose(bufs[0], bufs[1], rtol=0, atol=0)
+    for bufs in variants:
+        for b in bufs[1:]:
+            np.testing.assert_allclose(bufs[0], b, rtol=0, atol=0)
         if want is None:
             want = bufs[0]
         np.testing.assert_allclose(bufs[0], want, rtol=1e-5, atol=1e-6)
