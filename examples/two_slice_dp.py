@@ -17,8 +17,8 @@ Run as real multi-host slices (one process per host):
         --rank 0 --world 2 \\
         --peers hostA,hostB --steps 50     ... --rank 1 ...
 
-On TPU pods, drop --force-cpu and size --mesh to the slice topology
-(e.g. "dp=2,tp=4" on a v5e-8).
+On TPU pods, pass --tpu (hardware-free runs default to CPU) and size
+--mesh to the slice topology (e.g. "dp=2,tp=4" on a v5e-8).
 """
 
 import argparse

@@ -8,30 +8,49 @@ host-staged DCN copy, leaving intra-slice traffic on ICI where XLA's
 own collectives are already optimal (SURVEY.md §5 "Distributed
 communication backend").
 
-Data path per pytree:
-  1. Leaves are grouped by dtype and packed into one flat buffer per
-     dtype (bigger messages ⇒ ring stays at peak bus bandwidth).
-  2. Zero-copy attempt: export each device buffer as dma-buf and
-     register it with the engine directly (no host bytes; the MR posts
-     read TPU HBM). Gated on the exporter — current public libtpu
-     cannot export, so:
-  3. Staged fallback: device→host get, ring allreduce on the host
-     buffer, host→device put — with every staged byte charged to
+Data path per pytree, in preference order:
+
+  1. **Zero-copy** (the reference's whole value proposition — zero
+     software on the hot path after registration, amdp2p.c §3.3): a
+     leaf resident in exporter ("HBM") memory is pinned through the
+     full acquire→get_pages→export_dmabuf pipeline, its dma-buf fd is
+     registered with the engine (``reg_dmabuf_mr``), the resulting MR
+     is adopted by the ring, and the allreduce runs IN PLACE on the
+     registered device region. No host bytes move; ``staging`` stays
+     untouched, making BASELINE config 3's zero-staging criterion a
+     passing assertion (``staging.expect_zero``). Registration is
+     front-loaded and cached, so steady-state steps post work requests
+     only. If the owner frees the memory mid-collective, the exporter's
+     free_callback invalidates the MR and the collective fails with a
+     transport error instead of touching reclaimed pages.
+  2. **Staged fallback** for leaves the exporter does not own (or with
+     no exporter at all): leaves are grouped by dtype and packed into
+     one flat pinned host buffer per dtype, ring allreduce on the host
+     buffer, then scattered back — with every staged byte charged to
      ``collectives.staging`` so the distance from the zero-staging
      target is always visible.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from rocnrdma_tpu.collectives.staging import staging
 from rocnrdma_tpu.collectives.world import RingWorld
-from rocnrdma_tpu.hbm.registry import HbmError, MemoryExporter
+from rocnrdma_tpu.hbm.registry import (HbmError, MemoryExporter,
+                                       RegistrationManager, as_ndarray)
 from rocnrdma_tpu.transport.engine import RED_SUM
 from rocnrdma_tpu.utils.trace import trace
+
+# Adjacent device leaves (same dtype, same allocation) are coalesced
+# into one ring op across alignment gaps up to this many bytes — a
+# DeviceArena's 64B-aligned leaves merge into a single message. Gap
+# bytes are reduced along with the data (their contents are garbage in,
+# garbage out — nothing reads them); the threshold keeps the wasted
+# traffic negligible.
+_COALESCE_GAP_MAX = 512
 
 
 def _leaf_list(tree) -> List[Any]:
@@ -45,6 +64,19 @@ class CrossSliceAllReduce:
 
     ``mean=True`` divides by world size after the sum — the gradient
     averaging used by the DP trainer (BASELINE.md config 4).
+
+    SPMD contract (the same one every collective library imposes): all
+    ranks must call with trees of identical structure, dtypes, shapes,
+    AND residency — a leaf that is device-resident (zero-copy) on one
+    rank must be device-resident on every rank, in the same relative
+    layout, or the per-rank ring schedules disagree and the collective
+    fails (completion error or stall, never silent corruption). The
+    easy way to guarantee this is to allocate the tree identically on
+    every rank — e.g. from a ``DeviceArena`` in the same take() order.
+
+    A leaf buffer appearing more than once in the tree (tied weights)
+    is reduced ONCE on the zero-copy path; every alias sees the reduced
+    value, which is the in-place semantics tied parameters want.
     """
 
     def __init__(self, world: RingWorld,
@@ -58,38 +90,137 @@ class CrossSliceAllReduce:
         # post work requests only, and the ring never sees a recycled
         # allocator address.
         self._staging: Dict[str, np.ndarray] = {}
+        # Zero-copy registration cache: (va, nbytes) -> Registration.
+        # The MR is adopted by the ring; both sides are front-loaded.
+        self._regs: Dict[Tuple[int, int], Any] = {}
+        self._regmgr: Optional[RegistrationManager] = None
 
-    def _stage(self, dtype_str: str, count: int) -> np.ndarray:
-        buf = self._staging.get(dtype_str)
-        if buf is None or buf.size < count:
-            if buf is not None:
-                # Unpin the outgrown buffer before dropping it — a
-                # stale MR over freed memory could alias a recycled
-                # allocation (and on verbs it pins the old pages).
-                self.world.ring.unregister_buffer(buf)
-            buf = np.empty(count, dtype=dtype_str)
-            self._staging[dtype_str] = buf
-            self.world.ring.register_buffer(buf)
-        return buf
+    # -------------------------------------------------- zero-copy path
+
+    def _device_leaf(self, leaf) -> Optional[Tuple[int, int]]:
+        """(va, nbytes) when ``leaf`` is a C-contiguous numpy array
+        resident in exporter memory — eligible for the zero-copy path."""
+        if self.exporter is None or not isinstance(leaf, np.ndarray):
+            return None
+        if not leaf.flags["C_CONTIGUOUS"] or leaf.nbytes == 0:
+            return None
+        va, nbytes = leaf.ctypes.data, leaf.nbytes
+        if self.exporter.is_device_address(va, nbytes):
+            return va, nbytes
+        return None
+
+    def _ensure_registered(self, va: int, nbytes: int) -> None:
+        """Front-load the pin + dma-buf MR + ring adoption for a
+        device region (cached; repeat calls are dictionary hits)."""
+        reg = self._regs.get((va, nbytes))
+        if reg is not None and reg.ctx.revoked:
+            # Owner freed the memory while registered: the exporter's
+            # free_callback already invalidated the MR (amdp2p.c:88-109
+            # semantics). Drop the dead entry; re-registration below
+            # will fail in acquire, surfacing the lifetime bug.
+            self.world.ring.drop_buffer(va)
+            self._regmgr.deregister(reg)
+            del self._regs[(va, nbytes)]
+            reg = None
+        if reg is not None:
+            return
+        if self._regmgr is None:
+            self._regmgr = RegistrationManager(self.world.engine,
+                                               self.exporter)
+        reg = self._regmgr.register(va, nbytes)  # dma-buf preferred
+        self.world.ring.adopt_mr(va, reg.mr)
+        self._regs[(va, nbytes)] = reg
+        trace.event("xslice.zero_copy_reg", va=va, bytes=nbytes)
+
+    def _zero_copy(self, leaf: np.ndarray, va: int, nbytes: int,
+                   op: int = RED_SUM) -> None:
+        """Allreduce a device-resident region in place with no host
+        staging: ring posts go directly against the dma-buf MR."""
+        self._ensure_registered(va, nbytes)
+        self.world.allreduce(leaf, op)
+        if self.mean:
+            if leaf.dtype.kind in "iu":
+                leaf //= self.world.world
+            else:
+                leaf /= np.asarray(self.world.world, dtype=leaf.dtype)
+
+    def _coalesce(self, regions):
+        """Merge adjacent same-dtype device regions (sorted by VA)
+        into single ring ops. ``regions``: [(va, nbytes, leaf)] →
+        [(va, nbytes, array_to_reduce)]. Leaves allocated from one
+        DeviceArena merge into ONE message — full-bandwidth rings need
+        big messages, and per-leaf ops would pay ring latency per leaf."""
+        regions = sorted(regions, key=lambda t: t[0])
+        merged = []
+        run = None  # [va, end, dtype, leaves]
+        for va, nbytes, leaf in regions:
+            if run is not None and va < run[1]:
+                raise HbmError(
+                    f"overlapping device leaves at {va:#x} (in-place "
+                    "reduction over overlapping regions is ill-defined)")
+            gap = va - run[1] if run is not None else 0
+            if (run is not None and leaf.dtype == run[2]
+                    and 0 <= gap <= _COALESCE_GAP_MAX
+                    and (va + nbytes - run[0]) % leaf.dtype.itemsize == 0
+                    and self.exporter.is_device_address(
+                        run[0], va + nbytes - run[0])):
+                run[1] = va + nbytes
+                run[3].append(leaf)
+            else:
+                if run is not None:
+                    merged.append(run)
+                run = [va, va + nbytes, leaf.dtype, [leaf]]
+        if run is not None:
+            merged.append(run)
+
+        out = []
+        for va, end, dtype, leaves in merged:
+            if len(leaves) == 1:
+                out.append((va, end - va, leaves[0]))
+            else:
+                span = as_ndarray(va, ((end - va) // dtype.itemsize,),
+                                  dtype)
+                out.append((va, end - va, span))
+        return out
+
+    # ------------------------------------------------------- main path
 
     def __call__(self, tree):
         import jax
-        import jax.numpy as jnp
 
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         if not leaves:
             return tree
 
-        # Group leaf indices by dtype; one packed ring op per dtype.
-        groups: Dict[str, List[int]] = {}
-        for i, leaf in enumerate(leaves):
-            groups.setdefault(str(leaf.dtype), []).append(i)
-
         out: List[Any] = list(leaves)
+        n_zero_copy = 0
+
+        # Zero-copy pass: device-resident leaves reduce in place.
+        # Aliased leaves (the same buffer appearing twice — tied
+        # weights) reduce once; adjacent regions coalesce into single
+        # ring ops (see _coalesce).
+        staged_idx: List[int] = []
+        dev_regions: List[Tuple[int, int, Any]] = []
+        seen: set = set()
+        for i, leaf in enumerate(leaves):
+            dev = self._device_leaf(leaf)
+            if dev is None:
+                staged_idx.append(i)
+                continue
+            n_zero_copy += 1
+            if dev in seen:
+                continue
+            seen.add(dev)
+            dev_regions.append((dev[0], dev[1], leaf))
+        for va, nbytes, arr in self._coalesce(dev_regions):
+            self._zero_copy(arr, va, nbytes)
+
+        # Staged fallback for everything else, packed per dtype.
+        groups: Dict[str, List[int]] = {}
+        for i in staged_idx:
+            groups.setdefault(str(leaves[i].dtype), []).append(i)
+
         for dtype_str, idxs in groups.items():
-            # Zero-copy path would go here (export_dmabuf +
-            # reg_dmabuf_mr on the device buffers); with no exporter
-            # this is the staged get into the pinned staging buffer.
             host_parts = [np.asarray(jax.device_get(leaves[i]))
                           for i in idxs]
             shapes = [p.shape for p in host_parts]
@@ -121,6 +252,41 @@ class CrossSliceAllReduce:
                     # dp×tp mesh doesn't funnel gradients through one
                     # device.
                     out[i] = jax.device_put(piece, leaves[i].sharding)
-        trace.event("xslice.allreduce",
-                    leaves=len(leaves), groups=len(groups))
+        trace.event("xslice.allreduce", leaves=len(leaves),
+                    zero_copy=n_zero_copy, staged=len(staged_idx))
         return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _stage(self, dtype_str: str, count: int) -> np.ndarray:
+        buf = self._staging.get(dtype_str)
+        if buf is None or buf.size < count:
+            if buf is not None:
+                # Unpin the outgrown buffer before dropping it — a
+                # stale MR over freed memory could alias a recycled
+                # allocation (and on verbs it pins the old pages).
+                self.world.ring.unregister_buffer(buf)
+            buf = np.empty(count, dtype=dtype_str)
+            self._staging[dtype_str] = buf
+            self.world.ring.register_buffer(buf)
+        return buf
+
+    def close(self) -> None:
+        """Release the zero-copy registrations (unadopt from the ring,
+        then unpin). Call before tearing down the world."""
+        for (va, _), reg in list(self._regs.items()):
+            try:
+                self.world.ring.drop_buffer(va)
+            except Exception:
+                pass  # ring may already be gone
+            try:
+                self._regmgr.deregister(reg)
+            except HbmError:
+                pass  # already revoked
+        self._regs.clear()
+        if self._regmgr is not None:
+            self._regmgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -206,6 +206,83 @@ class FakeHBMExporter(MemoryExporter):
             return len(self._pins)
 
 
+def as_ndarray(va: int, shape, dtype):
+    """View exporter ("HBM") memory at ``va`` as a numpy array.
+
+    The array is a raw view: the exporter owns the memory's lifetime,
+    and the view dangles after ``exporter.free(va)`` — exactly the
+    use-after-free the revocation flow (SURVEY.md §3.4) exists to make
+    safe on the transport side. Callers must not touch the view after
+    freeing.
+    """
+    import ctypes
+
+    import numpy as np
+
+    shape = tuple(int(s) for s in shape)
+    count = int(np.prod(shape, dtype=np.int64))
+    nbytes = count * np.dtype(dtype).itemsize
+    buf = (ctypes.c_char * max(nbytes, 1)).from_address(va)
+    return np.frombuffer(buf, dtype=dtype, count=count).reshape(shape)
+
+
+def device_ndarray(exporter: MemoryExporter, shape, dtype):
+    """Allocate device memory from ``exporter`` and wrap it as a numpy
+    array — the hardware-free analogue of a JAX array living in HBM
+    (the fake exporter's memory IS what its dma-buf export exposes, so
+    collectives on such arrays can run zero-copy)."""
+    import numpy as np
+
+    shape = tuple(int(s) for s in shape)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    va = exporter.alloc(max(nbytes, 1))
+    return as_ndarray(va, shape, dtype)
+
+
+class DeviceArena:
+    """Bump allocator over ONE device allocation.
+
+    Allocating a whole gradient/parameter pytree from one arena makes
+    its leaves adjacent in device memory, so the zero-copy collective
+    coalesces the entire tree into a single ring op (one registration,
+    one allreduce at full message size) instead of one op per leaf.
+    All ranks must allocate the same leaves in the same order so the
+    coalesced layout — and therefore the collective schedule — matches
+    across the ring (the usual SPMD contract).
+    """
+
+    def __init__(self, exporter: MemoryExporter, nbytes: int,
+                 align: int = 64):
+        self.exporter = exporter
+        self.base = exporter.alloc(max(int(nbytes), 1))
+        self.size = int(nbytes)
+        self.align = int(align)
+        self._off = 0
+
+    def take(self, shape, dtype):
+        """Carve the next leaf out of the arena (64B-aligned)."""
+        import numpy as np
+
+        shape = tuple(int(s) for s in shape)
+        nbytes = (int(np.prod(shape, dtype=np.int64))
+                  * np.dtype(dtype).itemsize)
+        off = -(-self._off // self.align) * self.align
+        if off + nbytes > self.size:
+            raise HbmError(
+                f"arena exhausted: need {nbytes} at {off}, size {self.size}")
+        self._off = off + nbytes
+        return as_ndarray(self.base + off, shape, dtype)
+
+    def free(self) -> None:
+        self.exporter.free(self.base)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.free()
+
+
 class ClientContext:
     """Per-registration context — ``struct amd_mem_context``
     (amdp2p.c:73-85): va, size, the pin, and the revocation flag."""
@@ -288,13 +365,22 @@ class PeerClient:
 
     def _on_free(self, ctx: ClientContext) -> None:
         """Exporter-initiated revocation (free/exit while registered) —
-        free_callback, amdp2p.c:88-109: invalidate upward FIRST, then
-        flag the context so put_pages won't double-free."""
-        if self.invalidate_cb is not None and ctx.core_context is not None:
-            self.invalidate_cb(ctx.core_context)
+        free_callback, amdp2p.c:88-109: invalidate upward, then the
+        exporter reclaims pages on return.
+
+        core_context is read and the revoked flag set under ctx._lock
+        as ONE atomic step: if registration is still in flight (no
+        core_context yet), the registering thread is guaranteed to
+        observe ``revoked`` at its post-assembly check and unwind —
+        without this, a free landing in that window would leave a
+        valid MR over reclaimed pages (the crash the reference's
+        free_callback/put_pages handshake exists to prevent)."""
         with ctx._lock:
+            cc = ctx.core_context
             ctx.revoked = True
             ctx.pinned = None
+        if self.invalidate_cb is not None and cc is not None:
+            self.invalidate_cb(cc)
         trace.event("peer.revoked", va=ctx.va)
 
 
@@ -334,20 +420,35 @@ class RegistrationManager:
         if ctx is None:
             raise HbmError(f"[{va:#x},+{size}) is not exporter memory")
         self.client.get_pages(ctx, va, size)
+
+        def _check_not_revoked():
+            # The owner may free the memory at ANY point during
+            # registration (the §3.4 race). Once revoked, continuing —
+            # in particular falling back to a plain reg_mr on the VA —
+            # would create a live MR over reclaimed pages.
+            with ctx._lock:
+                revoked = ctx.revoked
+            if revoked:
+                raise HbmError(
+                    f"[{va:#x},+{size}) freed by owner during registration")
+
         try:
             page_size = self.client.get_page_size(ctx)
             sg = self.client.dma_map(ctx)
             mr = None
             if prefer_dmabuf:
-                # Any failure along the dma-buf path (no export support,
-                # or the engine rejecting the fd) falls back to the
-                # legacy direct registration below.
+                # Failures along the dma-buf path (no export support,
+                # or the engine rejecting the fd) fall back to the
+                # legacy direct registration below — unless the real
+                # cause is that the memory was just freed.
                 try:
                     fd, off = self.exporter.export_dmabuf(ctx.pinned)
                     mr = self.engine.reg_dmabuf_mr(fd, off, size, iova=va)
                 except Exception:
+                    _check_not_revoked()
                     mr = None
             if mr is None:
+                _check_not_revoked()
                 # Legacy path: register the bus-address range directly
                 # (the sg entries are flat in the fake exporter, as in
                 # the IOMMU-off world the reference assumes,
@@ -361,7 +462,20 @@ class RegistrationManager:
             self.client.release(ctx)
             raise
         reg = Registration(ctx=ctx, mr=mr, page_size=page_size, sg=sg)
-        ctx.core_context = reg
+        # Publish core_context and re-check revocation as one atomic
+        # step against _on_free (which reads core_context and sets
+        # revoked under the same lock): either the callback saw the
+        # registration and invalidated the MR, or we see the flag here
+        # and unwind — no window where a free leaves the MR live.
+        with ctx._lock:
+            ctx.core_context = reg
+            revoked = ctx.revoked
+        if revoked:
+            reg.mr.invalidate()
+            reg.mr.deregister()
+            self.client.release(ctx)
+            raise HbmError(
+                f"[{va:#x},+{size}) freed by owner during registration")
         with self._lock:
             self._live[id(reg)] = reg
         trace.event("regmgr.register", va=va, bytes=size)

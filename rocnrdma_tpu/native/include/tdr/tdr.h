@@ -143,6 +143,12 @@ int tdr_post_send_foldback(tdr_qp *qp, tdr_mr *lmr, size_t loff, size_t len,
                            uint64_t wr_id);
 int tdr_qp_has_send_foldback(tdr_qp *qp);
 
+/* Whether BOTH ends of this QP negotiated participation in the world-2
+ * fused exchange schedule (TDR_NO_FUSED2 opts a rank out at the
+ * handshake, degrading the whole connection to the compatible
+ * rightward schedules instead of a per-rank wire mismatch). */
+int tdr_qp_has_fused2(tdr_qp *qp);
+
 /* Poll up to `max` completions; waits up to timeout_ms (0 = non-block,
  * -1 = forever). Returns count, or -1 on error. */
 int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms);
@@ -179,6 +185,14 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
  * call (safe for arbitrary/recycled addresses, slower). */
 int tdr_ring_register(tdr_ring *r, void *base, size_t len);
 int tdr_ring_unregister(tdr_ring *r, void *base);
+/* Adopt an externally-owned MR (typically a dma-buf MR over device
+ * memory, tdr_reg_dmabuf_mr with iova = the device VA) as the data MR
+ * for allreduces whose data pointer equals `base`. The ring NEVER
+ * deregisters an adopted MR — the caller keeps ownership and must
+ * tdr_ring_unregister(base) before invalidating/deregistering it.
+ * This is the zero-copy collective path: the ring posts directly
+ * against pinned device memory, no host staging. */
+int tdr_ring_adopt_mr(tdr_ring *r, void *base, tdr_mr *mr);
 void tdr_ring_destroy(tdr_ring *r);
 
 #ifdef __cplusplus

@@ -141,6 +141,10 @@ int tdr_qp_has_send_foldback(tdr_qp *qp) {
   return reinterpret_cast<Qp *>(qp)->has_send_foldback() ? 1 : 0;
 }
 
+int tdr_qp_has_fused2(tdr_qp *qp) {
+  return reinterpret_cast<Qp *>(qp)->has_fused2() ? 1 : 0;
+}
+
 int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms) {
   return reinterpret_cast<Qp *>(qp)->poll(wc, max, timeout_ms);
 }

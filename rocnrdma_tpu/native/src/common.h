@@ -62,6 +62,10 @@ class Qp {
     return -1;
   }
   virtual bool has_send_foldback() const { return false; }
+  // Negotiated participation in the world-2 fused exchange schedule
+  // (wire-incompatible with the rightward-only schedules); both ends
+  // must advertise it in the handshake before a ring may enter it.
+  virtual bool has_fused2() const { return false; }
   virtual int poll(tdr_wc *wc, int max, int timeout_ms) = 0;
   virtual int close_qp() = 0;
 };

@@ -89,6 +89,12 @@ static_assert(sizeof(FrameHdr) == 40, "wire format");
 // its frames are only valid against a peer that folds them).
 enum : uint32_t {
   FEAT_FOLDBACK = 1u << 0,
+  // Participation in the world-2 fused exchange schedule (FusedTwo).
+  // Not a frame format by itself, but schedule-changing: a rank running
+  // FusedTwo sends phase-2 reduced-B chunks on its LEFT QP while the
+  // generic/wavefront schedules send everything rightward — the streams
+  // are wire-incompatible, so entry must be agreed by both ends.
+  FEAT_FUSED2 = 1u << 1,
 };
 
 // Connection handshake: each side announces identity and a probe
@@ -164,6 +170,7 @@ uint32_t local_features() {
   uint32_t f = 0;
   if (!env_set("TDR_NO_FOLDBACK") && !env_set("TDR_NO_FUSED2"))
     f |= FEAT_FOLDBACK;
+  if (!env_set("TDR_NO_FUSED2")) f |= FEAT_FUSED2;
   return f;
 }
 
@@ -188,6 +195,11 @@ class EmuMr : public Mr {
   // this reaching zero, matching ibv_dereg_mr's guarantee that the NIC
   // never touches the memory after dereg returns.
   std::atomic<int> inflight{0};
+  // Queued-recv references (PostedRecv::mr). Unlike inflight (active
+  // DMA, bounded-time), a queued recv may never match — dereg must
+  // NOT wait for these, so a dereg'd MR with live recv_refs parks in
+  // the engine graveyard instead of being freed.
+  std::atomic<int> recv_refs{0};
   int invalidate() override {
     valid.store(false, std::memory_order_release);
     return 0;
@@ -260,6 +272,9 @@ class EmuEngine : public Engine {
 
   int dereg_mr(Mr *mr) override {
     auto *emr = static_cast<EmuMr *>(mr);
+    // A dereg'd MR is no longer a valid landing target, whatever the
+    // caller did about invalidate() first.
+    emr->valid.store(false, std::memory_order_release);
     {
       std::lock_guard<std::mutex> g(mu_);
       mrs_.erase(mr->rkey);  // no new resolves from here on
@@ -268,8 +283,21 @@ class EmuEngine : public Engine {
     // Wait out in-flight "DMA" before freeing — ibv_dereg_mr semantics.
     while (emr->inflight.load(std::memory_order_acquire) > 0)
       std::this_thread::yield();
-    delete emr;
+    // Queued recvs may still hold this MR (they check `valid` before
+    // touching memory, but dereference the object to do so) — and may
+    // never match, so waiting here could hang forever. Park the MR in
+    // the graveyard instead; engine close frees it.
+    if (emr->recv_refs.load(std::memory_order_acquire) > 0) {
+      std::lock_guard<std::mutex> g(mu_);
+      graveyard_.push_back(emr);
+    } else {
+      delete emr;
+    }
     return 0;
+  }
+
+  ~EmuEngine() override {
+    for (EmuMr *mr : graveyard_) delete mr;
   }
 
   // Resolve (rkey, raddr, len) to a CPU pointer, enforcing validity,
@@ -319,6 +347,9 @@ class EmuEngine : public Engine {
   std::mutex mu_;
   std::unordered_map<uint32_t, EmuMr *> mrs_;
   std::unordered_map<uint32_t, char *> cpu_base_;  // dma-buf MRs only
+  // MRs dereg'd while queued recvs still referenced them (see
+  // dereg_mr); freed at engine close.
+  std::vector<EmuMr *> graveyard_;
   uint32_t next_key_ = 0x1000;
 };
 
@@ -337,6 +368,12 @@ struct PostedRecv {
   bool is_reduce = false;
   int dtype = 0;
   int red_op = 0;
+  // The MR dst resolves into. Holds a recv_ref from post until the
+  // recv completes/flushes, so the landing path can (a) re-check
+  // validity — a free-while-registered between post and landing must
+  // fail the recv, not write reclaimed memory — and (b) trust that
+  // the EmuMr object (and its dma-buf mapping) is still alive.
+  EmuMr *mr = nullptr;
 };
 
 class EmuQp : public Qp {
@@ -410,7 +447,9 @@ class EmuQp : public Qp {
       set_error("post_recv: invalid local MR range");
       return -1;
     }
-    return queue_recv({wr_id, dst, maxlen, false, 0, 0});
+    auto *emr = static_cast<EmuMr *>(lmr);
+    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
+    return queue_recv({wr_id, dst, maxlen, false, 0, 0, emr});
   }
 
   int post_send_foldback(Mr *lmr, size_t loff, size_t len,
@@ -441,6 +480,10 @@ class EmuQp : public Qp {
     return (features_ & FEAT_FOLDBACK) != 0;
   }
 
+  bool has_fused2() const override {
+    return (features_ & FEAT_FUSED2) != 0;
+  }
+
   int post_recv_reduce(Mr *lmr, size_t loff, size_t maxlen, int dtype,
                        int red_op, uint64_t wr_id) override {
     if (dtype_size(dtype) == 0) {
@@ -452,7 +495,9 @@ class EmuQp : public Qp {
       set_error("post_recv_reduce: invalid local MR range");
       return -1;
     }
-    return queue_recv({wr_id, dst, maxlen, true, dtype, red_op});
+    auto *emr = static_cast<EmuMr *>(lmr);
+    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
+    return queue_recv({wr_id, dst, maxlen, true, dtype, red_op, emr});
   }
 
   bool has_recv_reduce() const override { return true; }
@@ -499,6 +544,20 @@ class EmuQp : public Qp {
     uint64_t len = 0;
   };
 
+  // Drop a consumed recv's MR reference (the last act of every path
+  // that popped it — landing, flush, or immediate match).
+  static void release_recv(const PostedRecv &r) {
+    if (r.mr) r.mr->recv_refs.fetch_sub(1, std::memory_order_acq_rel);
+  }
+
+  // A recv's landing target is checked for validity at LANDING time,
+  // not just post time: a free-while-registered in between (owner
+  // revocation, amdp2p.c:88-109) must fail the recv, never write
+  // through the stale pointer.
+  static bool recv_target_valid(const PostedRecv &r) {
+    return r.mr == nullptr || r.mr->valid.load(std::memory_order_acquire);
+  }
+
   // Common tail of post_recv/post_recv_reduce: consume a buffered
   // unexpected message if one raced ahead, else enqueue.
   int queue_recv(PostedRecv r) {
@@ -509,9 +568,10 @@ class EmuQp : public Qp {
       lk.unlock();
       if (!u.fb) {
         push_wc(deliver_buffer_wc(r, u.payload.data(), u.payload.size()));
-        return 0;
+      } else {
+        finish_foldback(r, u);
       }
-      finish_foldback(r, u);
+      release_recv(r);
       return 0;
     }
     recvs_.push_back(r);
@@ -528,8 +588,8 @@ class EmuQp : public Qp {
     FrameHdr ack{};
     ack.op = OP_SEND_FB_ACK;
     ack.seq = u.seq;
-    bool fold_ok = r.is_reduce && u.len <= r.maxlen &&
-                   dtype_size(r.dtype) != 0 &&
+    bool fold_ok = r.is_reduce && recv_target_valid(r) &&
+                   u.len <= r.maxlen && dtype_size(r.dtype) != 0 &&
                    u.len % dtype_size(r.dtype) == 0;
     bool sent;
     if (!fold_ok) {
@@ -548,9 +608,11 @@ class EmuQp : public Qp {
       return sent;
     }
     // Stream tier: fold the payload in place (it ends up holding the
-    // folded values) and return it on the ack.
-    reduce2_any(r.dst, u.payload.data(), u.len / dtype_size(r.dtype),
-                r.dtype, r.red_op);
+    // folded values) and return it on the ack. Parallel fold — MB-sized
+    // chunks must not serialize on the progress thread when every other
+    // landing path (par_reduce, par_cma_reduce2) uses the copy pool.
+    par_reduce2_local(r.dst, u.payload.data(),
+                      u.len / dtype_size(r.dtype), r.dtype, r.red_op);
     ack.status = TDR_WC_SUCCESS;
     ack.len = u.len;
     sent = send_frame(ack, u.payload.data(), u.payload.size());
@@ -563,7 +625,7 @@ class EmuQp : public Qp {
   // handle_send_inbound for why delivery is deferred).
   tdr_wc deliver_buffer_wc(const PostedRecv &r, const char *data,
                            size_t len) {
-    if (len > r.maxlen ||
+    if (!recv_target_valid(r) || len > r.maxlen ||
         (r.is_reduce && len % dtype_size(r.dtype) != 0))
       return {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
     if (r.is_reduce)
@@ -578,7 +640,7 @@ class EmuQp : public Qp {
   // reduction, no scratch allocation. Returns false only on
   // connection loss.
   bool land_stream_wc(const PostedRecv &r, uint64_t len, tdr_wc *wc) {
-    if (len > r.maxlen ||
+    if (!recv_target_valid(r) || len > r.maxlen ||
         (r.is_reduce && len % dtype_size(r.dtype) != 0)) {
       if (!drain(len)) return false;
       *wc = {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
@@ -610,7 +672,7 @@ class EmuQp : public Qp {
   // Returns whether the data movement succeeded (the ack status).
   bool land_cma_wc(const PostedRecv &r, uint64_t src, uint64_t len,
                    tdr_wc *wc) {
-    if (len > r.maxlen ||
+    if (!recv_target_valid(r) || len > r.maxlen ||
         (r.is_reduce && len % dtype_size(r.dtype) != 0)) {
       *wc = {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
       return true;  // desc mode: nothing on the wire to drain
@@ -754,9 +816,13 @@ class EmuQp : public Qp {
                          ? TDR_WC_SUCCESS
                          : TDR_WC_GENERAL_ERR;
       } else {
-        if (!land_stream_wc(r, h.len, &wc)) return false;
+        if (!land_stream_wc(r, h.len, &wc)) {
+          release_recv(r);
+          return false;
+        }
         ack.status = TDR_WC_SUCCESS;
       }
+      release_recv(r);
       bool sent = send_frame(ack, nullptr, 0);
       push_wc(wc);
       return sent;
@@ -800,6 +866,7 @@ class EmuQp : public Qp {
         push_wc(deliver_buffer_wc(r2, buf.data(), buf.size()));
       else
         push_wc({r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
+      release_recv(r2);
     }
     return sent;
   }
@@ -837,7 +904,11 @@ class EmuQp : public Qp {
         unexpected_.push_back(std::move(u));
       }
     }
-    if (have) return finish_foldback(r, u);
+    if (have) {
+      bool sent = finish_foldback(r, u);
+      release_recv(r);
+      return sent;
+    }
     return true;
   }
 
@@ -1015,8 +1086,10 @@ class EmuQp : public Qp {
     for (auto &kv : pending_)
       cq_.push_back({kv.second.wr_id, TDR_WC_FLUSH_ERR, kv.second.opcode, 0});
     pending_.clear();
-    for (auto &r : recvs_)
+    for (auto &r : recvs_) {
       cq_.push_back({r.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0});
+      release_recv(r);
+    }
     recvs_.clear();
     cv_.notify_all();
   }

@@ -18,6 +18,7 @@
 #include <cstring>
 #include <mutex>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "common.h"
@@ -40,6 +41,18 @@ size_t ring_chunk_bytes() {
     if (v >= 4096) return static_cast<size_t>(v);
   }
   return kDefaultChunk;
+}
+
+// Stall deadline for a blocking drain (a peer that stopped sending —
+// crashed rank, revoked buffer — surfaces as this timeout). Tunable so
+// failure tests don't wait the production default.
+int ring_timeout_ms() {
+  const char *env = getenv("TDR_RING_TIMEOUT_MS");
+  if (env && *env) {
+    long long v = atoll(env);
+    if (v >= 100) return static_cast<int>(v);
+  }
+  return 30000;
 }
 
 using tdr::dtype_size;
@@ -69,6 +82,9 @@ struct tdr_ring {
   // out stale pins when an address gets recycled by the allocator
   // (the underlying physical pages of a dead buffer, not the new one).
   std::unordered_map<uint64_t, tdr_mr *> registered;
+  // Keys of ADOPTED entries (tdr_ring_adopt_mr): the MR is owned by
+  // the caller (a dma-buf MR over device memory); never dereg it here.
+  std::unordered_set<uint64_t> borrowed;
   std::mutex mu;
 
   // Returns the MR and whether it is borrowed (cached) or owned by
@@ -117,7 +133,8 @@ tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
 
 void tdr_ring_destroy(tdr_ring *r) {
   if (!r) return;
-  for (auto &kv : r->registered) tdr_dereg_mr(kv.second);
+  for (auto &kv : r->registered)
+    if (!r->borrowed.count(kv.first)) tdr_dereg_mr(kv.second);
   if (r->tmp_mr) tdr_dereg_mr(r->tmp_mr);
   delete r;
 }
@@ -148,10 +165,35 @@ int tdr_ring_register(tdr_ring *r, void *base, size_t len) {
 int tdr_ring_unregister(tdr_ring *r, void *base) {
   if (!r) return -1;
   std::lock_guard<std::mutex> g(r->mu);
-  auto it = r->registered.find(reinterpret_cast<uint64_t>(base));
+  uint64_t key = reinterpret_cast<uint64_t>(base);
+  auto it = r->registered.find(key);
   if (it == r->registered.end()) return -1;
-  tdr_dereg_mr(it->second);
+  if (r->borrowed.erase(key) == 0) tdr_dereg_mr(it->second);
   r->registered.erase(it);
+  return 0;
+}
+
+// Adopt a caller-owned MR (dma-buf over device memory, iova == base)
+// as the data MR for `base` — the zero-copy collective path. The
+// caller retains ownership: unregister/destroy never dereg it.
+int tdr_ring_adopt_mr(tdr_ring *r, void *base, tdr_mr *mr) {
+  if (!r || !base || !mr) {
+    tdr::set_error("ring_adopt_mr: bad args");
+    return -1;
+  }
+  if (tdr_mr_addr(mr) != reinterpret_cast<uint64_t>(base)) {
+    tdr::set_error("ring_adopt_mr: MR iova does not match base");
+    return -1;
+  }
+  std::lock_guard<std::mutex> g(r->mu);
+  uint64_t key = reinterpret_cast<uint64_t>(base);
+  auto it = r->registered.find(key);
+  if (it != r->registered.end()) {
+    if (r->borrowed.erase(key) == 0) tdr_dereg_mr(it->second);
+    r->registered.erase(it);
+  }
+  r->registered[key] = mr;
+  r->borrowed.insert(key);
   return 0;
 }
 
@@ -289,7 +331,7 @@ struct StepPipe {
       // All sends posted: block for what remains.
       bool need_recv = done_r < n_recv;
       tdr_qp *qp = need_recv ? r->left : r->right;
-      int n = drain(qp, 30000);
+      int n = drain(qp, ring_timeout_ms());
       if (n < 0) return -1;
       if (n == 0) {
         tdr::set_error("ring: poll timeout");
@@ -448,7 +490,7 @@ struct FusedTwo {
         // completions (progress threads keep both moving regardless).
         bool left_owes =
             done_rB < n_b || acked_sB < posted_sB;
-        int n = drain(left_owes, 30000);
+        int n = drain(left_owes, ring_timeout_ms());
         if (n < 0) return -1;
         if (n == 0) {
           tdr::set_error(
@@ -465,11 +507,6 @@ struct FusedTwo {
     return 0;
   }
 };
-
-bool fused2_disabled() {
-  const char *env = getenv("TDR_NO_FUSED2");
-  return env && *env && *env != '0';
-}
 
 bool wavefront_disabled() {
   const char *env = getenv("TDR_NO_WAVEFRONT");
@@ -567,7 +604,7 @@ struct Wavefront {
       if (nl > 0 || nr > 0) progressed = true;
       if (!progressed) {
         bool left_owes = done_r < M;
-        int n = drain(left_owes, 30000);
+        int n = drain(left_owes, ring_timeout_ms());
         if (n < 0) return -1;
         if (n == 0) {
           tdr::set_error("ring(wave): poll timeout (s " +
@@ -626,8 +663,13 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
   // World-2 fast path: phases overlapped chunk-wise (see FusedTwo).
   // Segment roles per the generic schedule below at world=2: this rank
   // sends seg[rank] out first (phase-1 send) and folds seg[1-rank].
+  // Entry is gated on the NEGOTIATED fused2 capability (both ends
+  // advertised it in the handshake; TDR_NO_FUSED2 acts there), so a
+  // per-rank opt-out degrades BOTH ranks to the compatible rightward
+  // schedule instead of a wire mismatch.
   if (world == 2 && r->left != r->right &&
-      tdr_qp_has_recv_reduce(r->left) && !fused2_disabled()) {
+      tdr_qp_has_recv_reduce(r->left) && tdr_qp_has_fused2(r->left) &&
+      tdr_qp_has_fused2(r->right)) {
     FusedTwo f{r,
                dmr,
                dtype,

@@ -147,6 +147,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_register.argtypes = [P, P, ctypes.c_size_t]
     lib.tdr_ring_unregister.restype = ctypes.c_int
     lib.tdr_ring_unregister.argtypes = [P, P]
+    lib.tdr_ring_adopt_mr.restype = ctypes.c_int
+    lib.tdr_ring_adopt_mr.argtypes = [P, P, P]
+    lib.tdr_qp_has_fused2.restype = ctypes.c_int
+    lib.tdr_qp_has_fused2.argtypes = [P]
     lib.tdr_ring_allreduce.restype = ctypes.c_int
     lib.tdr_ring_allreduce.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
@@ -305,6 +309,12 @@ class QueuePair:
         return bool(_load().tdr_qp_has_send_foldback(
             _live(self._h, "has_send_foldback")))
 
+    @property
+    def has_fused2(self) -> bool:
+        """Both ends negotiated the world-2 fused exchange schedule."""
+        return bool(_load().tdr_qp_has_fused2(
+            _live(self._h, "has_fused2")))
+
     def poll(self, max_wc: int = 16, timeout_ms: int = -1) -> List[Completion]:
         arr = (Wc * max_wc)()
         n = _load().tdr_poll(_live(self._h, "poll"), arr, max_wc, timeout_ms)
@@ -364,6 +374,25 @@ class Ring:
         ``register_buffer`` (call before freeing the buffer)."""
         rc = _load().tdr_ring_unregister(
             _live(self._h, "ring_unregister"), array.ctypes.data)
+        _check(rc == 0, "ring_unregister")
+
+    def adopt_mr(self, addr: int, mr: MemoryRegion) -> None:
+        """Adopt a caller-owned MR (typically a dma-buf MR over device
+        memory with iova == addr) as the data MR for allreduces on
+        ``addr`` — the zero-copy collective path. The ring never
+        deregisters an adopted MR; call ``drop_buffer(addr)`` before
+        invalidating or deregistering it."""
+        rc = _load().tdr_ring_adopt_mr(
+            _live(self._h, "ring_adopt_mr"), addr,
+            _live(mr._h, "ring_adopt_mr mr"))
+        _check(rc == 0, "ring_adopt_mr")
+
+    def drop_buffer(self, addr: int) -> None:
+        """Drop the cached MR for ``addr`` (registered or adopted) by
+        raw address. Adopted MRs stay alive — ownership is the
+        caller's."""
+        rc = _load().tdr_ring_unregister(
+            _live(self._h, "ring_unregister"), addr)
         _check(rc == 0, "ring_unregister")
 
     def allreduce(self, array, op: int = RED_SUM) -> None:

@@ -190,6 +190,55 @@ print("OK")
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
 
 
+def test_fused2_env_mismatch_negotiates_down():
+    """A rank with TDR_NO_FUSED2 set must not wedge a peer without it.
+    FusedTwo's schedule is wire-incompatible with the rightward
+    schedules (phase-2 reduced-B chunks ride the LEFT QP), so entry is
+    gated on the negotiated FEAT_FUSED2 bit: a mismatched pair must
+    degrade BOTH ranks to the compatible schedule and still produce
+    the correct sum."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    script = """
+import os
+import socket
+
+import numpy as np
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+base = s.getsockname()[1]; s.close()
+count = (4 << 20) // 4
+
+pid = os.fork()
+rank = 1 if pid == 0 else 0
+if rank == 1:
+    os.environ["TDR_NO_FUSED2"] = "1"   # only this rank opts out
+from rocnrdma_tpu.collectives.world import RingWorld
+from rocnrdma_tpu.transport.engine import Engine
+
+w = RingWorld(Engine("emu"), rank, 2, base + 100)
+assert not w.right_qp.has_fused2  # negotiated OFF for both ends
+buf = np.full(count, float(rank + 1), dtype=np.float32)
+w.allreduce(buf)
+ok = bool(np.all(buf == 3.0))
+w.close()
+if pid == 0:
+    os._exit(0 if ok else 1)
+assert ok
+_, status = os.waitpid(pid, 0)
+assert os.waitstatus_to_exitcode(status) == 0
+print("OK")
+"""
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+
+
 def test_foldback_bf16_bit_identical(loop):
     e, a, b = loop
     import ml_dtypes

@@ -1,0 +1,249 @@
+"""Zero-copy collective path tests (BASELINE.md config 3).
+
+The reference's entire value proposition is zero software on the hot
+path after registration (amdp2p.c §3.3): after ``reg_mr`` on device
+memory the NIC DMAs straight out of it — no host bounce. These tests
+prove the TPU-side analogue end-to-end in the hardware-free world: a
+pytree allreduce over ``FakeHBMExporter`` memory runs through
+acquire→get_pages→export_dmabuf→reg_dmabuf_mr→ring with ZERO bytes
+staged through host buffers (``staging.expect_zero``), and revocation
+(free-while-registered, amdp2p.c:88-109) invalidates the MR instead of
+leaving the collective reading reclaimed pages.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+from rocnrdma_tpu.collectives.staging import staging
+from rocnrdma_tpu.collectives.world import local_worlds
+from rocnrdma_tpu.hbm.registry import (DeviceArena, FakeHBMExporter,
+                                       HbmError, device_ndarray)
+from rocnrdma_tpu.transport.engine import TransportError
+
+from test_transport import free_port
+from test_collectives import run_ranks
+
+
+def make_world2():
+    worlds = local_worlds(2, free_port() + 100)
+    exporters = [FakeHBMExporter(), FakeHBMExporter()]
+    shims = [CrossSliceAllReduce(worlds[r], exporter=exporters[r])
+             for r in range(2)]
+    return worlds, exporters, shims
+
+
+def close_all(worlds, shims):
+    for s in shims:
+        s.close()
+    for w in worlds:
+        w.close()
+
+
+def test_zero_copy_pytree_expect_zero():
+    """2-rank pytree allreduce over FakeHBMExporter with zero host
+    staging — the config-3 acceptance criterion as a passing test."""
+    worlds, exporters, shims = make_world2()
+    rng = np.random.default_rng(7)
+
+    trees = []
+    for r in range(2):
+        w = device_ndarray(exporters[r], (128, 33), np.float32)
+        b = device_ndarray(exporters[r], (257,), np.float32)
+        n = device_ndarray(exporters[r], (50,), np.int32)
+        w[:] = rng.standard_normal((128, 33)).astype(np.float32)
+        b[:] = rng.standard_normal(257).astype(np.float32)
+        n[:] = rng.integers(-100, 100, 50).astype(np.int32)
+        trees.append({"w": w, "b": b, "n": n})
+
+    expect = {k: trees[0][k] + trees[1][k] for k in trees[0]}
+
+    staging.reset()
+    with staging.expect_zero():
+        run_ranks(worlds, lambda w, r: shims[r](trees[r]))
+
+    for r in range(2):
+        for k in expect:
+            np.testing.assert_allclose(trees[r][k], expect[k],
+                                       rtol=1e-5, atol=1e-5)
+    close_all(worlds, shims)
+
+
+def test_zero_copy_steady_state_cached_registration():
+    """Second allreduce on the same buffers does no new registration
+    (front-loaded registration invariant) and stays zero-staging."""
+    worlds, exporters, shims = make_world2()
+    bufs = [device_ndarray(exporters[r], (4096,), np.float32)
+            for r in range(2)]
+    for r in range(2):
+        bufs[r][:] = r + 1
+
+    run_ranks(worlds, lambda w, r: shims[r](bufs[r]))
+    regs_after_first = [dict(s._regs) for s in shims]
+
+    with staging.expect_zero():
+        run_ranks(worlds, lambda w, r: shims[r](bufs[r]))
+
+    for r in range(2):
+        assert shims[r]._regs == regs_after_first[r], "re-registered"
+        # sum twice: (1+2)=3 after first, 3+3=6 after second
+        np.testing.assert_allclose(bufs[r], np.full(4096, 6.0), rtol=1e-6)
+    close_all(worlds, shims)
+
+
+def test_zero_copy_mean():
+    worlds, exporters, shims = make_world2()
+    for s in shims:
+        s.mean = True
+    bufs = [device_ndarray(exporters[r], (1000,), np.float32)
+            for r in range(2)]
+    bufs[0][:] = 1.0
+    bufs[1][:] = 3.0
+    with staging.expect_zero():
+        run_ranks(worlds, lambda w, r: shims[r](bufs[r]))
+    for r in range(2):
+        np.testing.assert_allclose(bufs[r], np.full(1000, 2.0), rtol=1e-6)
+    close_all(worlds, shims)
+
+
+def test_mixed_tree_stages_only_host_leaves():
+    """Device leaves ride zero-copy; a plain host leaf in the same tree
+    takes the staged fallback — and only ITS bytes are charged."""
+    worlds, exporters, shims = make_world2()
+    dev = [device_ndarray(exporters[r], (512,), np.float32)
+           for r in range(2)]
+    host = [np.full(100, float(r + 1), np.float32) for r in range(2)]
+    for r in range(2):
+        dev[r][:] = r + 1
+
+    staging.reset()
+    out = [None, None]
+
+    def step(w, r):
+        out[r] = shims[r]({"dev": dev[r], "host": host[r]})
+
+    run_ranks(worlds, step)
+
+    # Exactly the host leaf's round trip was staged, on each rank.
+    assert staging.bytes == 2 * (100 * 4 * 2)
+    for r in range(2):
+        np.testing.assert_allclose(out[r]["dev"], np.full(512, 3.0))
+        np.testing.assert_allclose(out[r]["host"], np.full(100, 3.0))
+        assert out[r]["dev"] is dev[r]  # reduced in place
+    close_all(worlds, shims)
+
+
+def test_arena_tree_coalesces_to_one_ring_op():
+    """A pytree allocated from one DeviceArena reduces as a SINGLE
+    registration + ring op (adjacent leaves coalesce across alignment
+    gaps), still zero-staging and still correct per leaf."""
+    worlds, exporters, shims = make_world2()
+    rng = np.random.default_rng(3)
+    arenas = [DeviceArena(exporters[r], 1 << 20) for r in range(2)]
+
+    trees = []
+    for r in range(2):
+        # Odd sizes so alignment gaps exist between leaves.
+        w = arenas[r].take((37, 11), np.float32)
+        b = arenas[r].take((203,), np.float32)
+        v = arenas[r].take((5,), np.float32)
+        w[:] = rng.standard_normal((37, 11)).astype(np.float32)
+        b[:] = rng.standard_normal(203).astype(np.float32)
+        v[:] = rng.standard_normal(5).astype(np.float32)
+        trees.append({"w": w, "b": b, "v": v})
+
+    expect = {k: trees[0][k] + trees[1][k] for k in trees[0]}
+
+    with staging.expect_zero():
+        run_ranks(worlds, lambda w, r: shims[r](trees[r]))
+
+    for r in range(2):
+        assert len(shims[r]._regs) == 1, "leaves did not coalesce"
+        for k in expect:
+            np.testing.assert_allclose(trees[r][k], expect[k],
+                                       rtol=1e-5, atol=1e-5)
+    close_all(worlds, shims)
+    for a in arenas:
+        a.free()
+
+
+def test_tied_leaf_reduced_once():
+    """The same buffer appearing twice in the tree (tied weights) is
+    reduced ONCE — not doubled by two in-place ring ops."""
+    worlds, exporters, shims = make_world2()
+    bufs = [device_ndarray(exporters[r], (256,), np.float32)
+            for r in range(2)]
+    for r in range(2):
+        bufs[r][:] = float(r + 1)
+    with staging.expect_zero():
+        run_ranks(worlds,
+                  lambda w, r: shims[r]({"emb": bufs[r], "out": bufs[r]}))
+    for r in range(2):
+        np.testing.assert_allclose(bufs[r], np.full(256, 3.0), rtol=1e-6)
+    close_all(worlds, shims)
+
+
+def test_revocation_invalidates_cached_registration():
+    """Free-while-registered: the exporter's free_callback invalidates
+    the MR (amdp2p.c:88-109); the next collective touching the dead
+    region fails in re-registration — it never reads reclaimed pages."""
+    worlds, exporters, shims = make_world2()
+    bufs = [device_ndarray(exporters[r], (2048,), np.float32)
+            for r in range(2)]
+    for r in range(2):
+        bufs[r][:] = 1.0
+    run_ranks(worlds, lambda w, r: shims[r](bufs[r]))
+
+    (va0, n0), = list(shims[0]._regs.keys())
+    reg0 = shims[0]._regs[(va0, n0)]
+    assert not reg0.ctx.revoked
+    exporters[0].free(va0)
+    assert reg0.ctx.revoked  # free_callback fired synchronously
+
+    # NOTE: bufs[0] now dangles; the shim must fail before touching it.
+    with pytest.raises(HbmError):
+        shims[0]._ensure_registered(va0, n0)
+    assert (va0, n0) not in shims[0]._regs
+    close_all(worlds, shims)
+
+
+def test_revocation_mid_collective_no_crash(monkeypatch):
+    """Free a rank's buffer while a large allreduce is in flight: the
+    collective either fails with a transport/lifetime error or had
+    already completed — it must never crash or hang."""
+    # The surviving peer detects the dead collective via the ring stall
+    # deadline; shorten it so the test doesn't sit out the 30s default.
+    monkeypatch.setenv("TDR_RING_TIMEOUT_MS", "2000")
+    worlds, exporters, shims = make_world2()
+    count = 32 << 20  # 128 MiB f32 — long enough to race against
+    bufs = [device_ndarray(exporters[r], (count,), np.float32)
+            for r in range(2)]
+    for r in range(2):
+        bufs[r][:1] = 1.0  # touch to fault pages in
+
+    errs = [None, None]
+
+    def step(r):
+        try:
+            shims[r](bufs[r])
+        except (TransportError, HbmError) as e:
+            errs[r] = e
+
+    ts = [threading.Thread(target=step, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    time.sleep(0.005)
+    va0 = bufs[0].ctypes.data
+    exporters[0].free(va0)
+    for t in ts:
+        t.join(timeout=90)
+        assert not t.is_alive(), "allreduce hung after revocation"
+    # Revocation must have been observed by rank 0's registration
+    # whether or not the race landed mid-transfer.
+    for (va, n), reg in shims[0]._regs.items():
+        if va == va0:
+            assert reg.ctx.revoked
+    close_all(worlds, shims)
