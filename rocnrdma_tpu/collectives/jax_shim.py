@@ -46,10 +46,12 @@ from rocnrdma_tpu.utils.trace import trace
 
 # Adjacent device leaves (same dtype, same allocation) are coalesced
 # into one ring op across alignment gaps up to this many bytes — a
-# DeviceArena's 64B-aligned leaves merge into a single message. Gap
-# bytes are reduced along with the data (their contents are garbage in,
-# garbage out — nothing reads them); the threshold keeps the wasted
-# traffic negligible.
+# DeviceArena's 64B-aligned leaves merge into a single message. Only
+# gaps the exporter proves DEAD (``is_gap_dead`` — padding marked by
+# DeviceArena.take) are merged: a gap holding live data (optimizer
+# state carved between two gradient leaves) must never be reduced.
+# Dead-gap bytes are garbage in, garbage out — nothing reads them; the
+# threshold keeps the wasted traffic negligible.
 _COALESCE_GAP_MAX = 512
 
 
@@ -116,11 +118,20 @@ class CrossSliceAllReduce:
         if reg is not None and reg.ctx.revoked:
             # Owner freed the memory while registered: the exporter's
             # free_callback already invalidated the MR (amdp2p.c:88-109
-            # semantics). Drop the dead entry; re-registration below
-            # will fail in acquire, surfacing the lifetime bug.
-            self.world.ring.drop_buffer(va)
-            self._regmgr.deregister(reg)
+            # semantics). Drop the dead entry FIRST so the cache
+            # converges even if cleanup throws (e.g. the ring already
+            # torn down), then best-effort unwind as close() does;
+            # re-registration below fails in acquire, surfacing the
+            # lifetime bug.
             del self._regs[(va, nbytes)]
+            try:
+                self.world.ring.drop_buffer(va)
+            except Exception:
+                pass  # ring may already be gone
+            try:
+                self._regmgr.deregister(reg)
+            except HbmError:
+                pass  # already revoked
             reg = None
         if reg is not None:
             return
@@ -160,7 +171,9 @@ class CrossSliceAllReduce:
                     "reduction over overlapping regions is ill-defined)")
             gap = va - run[1] if run is not None else 0
             if (run is not None and leaf.dtype == run[2]
-                    and 0 <= gap <= _COALESCE_GAP_MAX
+                    and (gap == 0
+                         or (0 < gap <= _COALESCE_GAP_MAX
+                             and self.exporter.is_gap_dead(run[1], va)))
                     and (va + nbytes - run[0]) % leaf.dtype.itemsize == 0
                     and self.exporter.is_device_address(
                         run[0], va + nbytes - run[0])):

@@ -59,6 +59,50 @@ class PinnedPages:
 class MemoryExporter:
     """The L2 contract (what ``drm/amd_rdma.h`` declared for KFD)."""
 
+    def __init__(self) -> None:
+        # Dead-gap registry: start -> end for ranges proved to hold no
+        # live data (alignment padding a DeviceArena skipped). Consulted
+        # by the zero-copy collective before coalescing across a gap.
+        self._dead: Dict[int, int] = {}
+        self._dead_lock = threading.Lock()
+
+    def mark_gap_dead(self, start: int, end: int) -> None:
+        """Record [start, end) as dead padding inside an allocation —
+        bytes no live data will ever occupy. The zero-copy collective
+        only coalesces adjacent leaves across gaps proved dead here:
+        reducing a gap holding live data (e.g. optimizer state carved
+        between two gradient leaves) would silently overwrite it with
+        the cross-rank sum."""
+        if end <= start:
+            return
+        with self._dead_lock:
+            self._dead[start] = max(end, self._dead.get(start, end))
+
+    def is_gap_dead(self, start: int, end: int) -> bool:
+        """True when [start, end) is fully covered by dead padding."""
+        if end <= start:
+            return True
+        with self._dead_lock:
+            pos = start
+            # Linear scan: padding counts are tiny (one per arena leaf).
+            while pos < end:
+                nxt = None
+                for s, e in self._dead.items():
+                    if s <= pos < e:
+                        nxt = e
+                        break
+                if nxt is None:
+                    return False
+                pos = nxt
+            return True
+
+    def _drop_dead_gaps_in(self, start: int, end: int) -> None:
+        """Forget dead ranges inside a freed allocation — its VA range
+        may be recycled by the allocator for live data."""
+        with self._dead_lock:
+            for s in [s for s in self._dead if start <= s < end]:
+                del self._dead[s]
+
     def is_device_address(self, va: int, size: int = 1) -> bool:
         raise NotImplementedError
 
@@ -97,6 +141,7 @@ class FakeHBMExporter(MemoryExporter):
     """
 
     def __init__(self, page_size: int = DEFAULT_PAGE_SIZE):
+        super().__init__()
         self.page_size = page_size
         self._lock = threading.Lock()
         # va -> (fd, mmap object, size)
@@ -140,6 +185,7 @@ class FakeHBMExporter(MemoryExporter):
                 self._pins.pop(id(pinned), None)
         with self._lock:
             del self._allocs[va]
+        self._drop_dead_gaps_in(va, va + size)
         try:
             m.close()
         except BufferError:
@@ -270,6 +316,12 @@ class DeviceArena:
         if off + nbytes > self.size:
             raise HbmError(
                 f"arena exhausted: need {nbytes} at {off}, size {self.size}")
+        if off > self._off:
+            # Alignment padding: provably dead bytes, safe for the
+            # zero-copy collective to coalesce across (and reduce as
+            # garbage-in/garbage-out).
+            self.exporter.mark_gap_dead(self.base + self._off,
+                                        self.base + off)
         self._off = off + nbytes
         return as_ndarray(self.base + off, shape, dtype)
 

@@ -78,6 +78,7 @@ class TPUExporter(MemoryExporter):
     """
 
     def __init__(self) -> None:
+        super().__init__()
         self._lock = threading.Lock()
         # va -> (array ref, nbytes)
         self._adopted: Dict[int, Tuple[object, int]] = {}
@@ -110,6 +111,7 @@ class TPUExporter(MemoryExporter):
                 self._pins.pop(id(pinned), None)
         with self._lock:
             del self._adopted[va]
+        self._drop_dead_gaps_in(va, va + nbytes)
         trace.event("tpu.release", va=va, revoked=len(doomed))
 
     def _containing(self, va: int) -> Optional[Tuple[int, int]]:

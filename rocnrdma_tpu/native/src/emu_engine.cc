@@ -286,13 +286,22 @@ class EmuEngine : public Engine {
     // Queued recvs may still hold this MR (they check `valid` before
     // touching memory, but dereference the object to do so) — and may
     // never match, so waiting here could hang forever. Park the MR in
-    // the graveyard instead; engine close frees it.
-    if (emr->recv_refs.load(std::memory_order_acquire) > 0) {
-      std::lock_guard<std::mutex> g(mu_);
-      graveyard_.push_back(emr);
-    } else {
-      delete emr;
+    // the graveyard instead; parked entries are reaped here once their
+    // recv_refs drain (bounding the graveyard for long-lived engines
+    // that cycle register→post→dereg), and engine close frees the rest.
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto it = graveyard_.begin(); it != graveyard_.end();) {
+      if ((*it)->recv_refs.load(std::memory_order_acquire) == 0) {
+        delete *it;
+        it = graveyard_.erase(it);
+      } else {
+        ++it;
+      }
     }
+    if (emr->recv_refs.load(std::memory_order_acquire) > 0)
+      graveyard_.push_back(emr);
+    else
+      delete emr;
     return 0;
   }
 
@@ -328,6 +337,22 @@ class EmuEngine : public Engine {
     if (mr) mr->inflight.fetch_sub(1, std::memory_order_acq_rel);
   }
 
+  // Begin a landing write into a posted recv's MR: raise inflight and
+  // re-check validity as one step under the engine mutex — the same
+  // mutex dereg_mr holds while revoking — so dereg_mr's inflight wait
+  // also covers in-progress recv landings. Without this, dereg_mr
+  // could return while a landing write into the MR's memory was still
+  // running and the owner could reclaim the pages mid-write (the
+  // ibv_dereg_mr guarantee the reference's put_pages path preserves,
+  // amdp2p.c:283-313). Caller must dma_done(mr) when the write ends.
+  bool landing_begin(EmuMr *mr) {
+    if (!mr) return true;
+    std::lock_guard<std::mutex> g(mu_);
+    if (!mr->valid.load(std::memory_order_acquire)) return false;
+    mr->inflight.fetch_add(1, std::memory_order_acq_rel);
+    return true;
+  }
+
   // Local-side resolve for the posting path (lkey semantics).
   char *local_ptr(Mr *mr, size_t loff, size_t len) {
     if (!mr->valid.load(std::memory_order_acquire)) return nullptr;
@@ -358,6 +383,14 @@ struct PendingOp {
   int opcode;     // TDR_OP_*
   char *dst;      // READ destination
   uint64_t len;
+};
+
+// RAII pair for EmuEngine::landing_begin: guarantees the inflight ref
+// drops on every exit path — a leaked ref would make dereg_mr spin
+// forever. Null mr is a no-op.
+struct DmaGuard {
+  EmuMr *mr;
+  ~DmaGuard() { EmuEngine::dma_done(mr); }
 };
 
 struct PostedRecv {
@@ -550,13 +583,11 @@ class EmuQp : public Qp {
     if (r.mr) r.mr->recv_refs.fetch_sub(1, std::memory_order_acq_rel);
   }
 
-  // A recv's landing target is checked for validity at LANDING time,
-  // not just post time: a free-while-registered in between (owner
-  // revocation, amdp2p.c:88-109) must fail the recv, never write
-  // through the stale pointer.
-  static bool recv_target_valid(const PostedRecv &r) {
-    return r.mr == nullptr || r.mr->valid.load(std::memory_order_acquire);
-  }
+  // A recv's landing target is re-validated at LANDING time via
+  // EmuEngine::landing_begin — a free-while-registered between post
+  // and landing (owner revocation, amdp2p.c:88-109) must fail the
+  // recv, never write through the stale pointer — and the landing
+  // write itself holds an inflight ref so dereg_mr waits it out.
 
   // Common tail of post_recv/post_recv_reduce: consume a buffered
   // unexpected message if one raced ahead, else enqueue.
@@ -588,9 +619,13 @@ class EmuQp : public Qp {
     FrameHdr ack{};
     ack.op = OP_SEND_FB_ACK;
     ack.seq = u.seq;
-    bool fold_ok = r.is_reduce && recv_target_valid(r) &&
-                   u.len <= r.maxlen && dtype_size(r.dtype) != 0 &&
-                   u.len % dtype_size(r.dtype) == 0;
+    bool fold_ok = r.is_reduce && u.len <= r.maxlen &&
+                   dtype_size(r.dtype) != 0 &&
+                   u.len % dtype_size(r.dtype) == 0 &&
+                   eng_->landing_begin(r.mr);
+    // landing_begin only ran (and succeeded) when fold_ok is true.
+    DmaGuard guard{fold_ok ? r.mr : nullptr};
+    (void)guard;
     bool sent;
     if (!fold_ok) {
       ack.status = TDR_WC_LOC_ACCESS_ERR;
@@ -625,9 +660,15 @@ class EmuQp : public Qp {
   // handle_send_inbound for why delivery is deferred).
   tdr_wc deliver_buffer_wc(const PostedRecv &r, const char *data,
                            size_t len) {
-    if (!recv_target_valid(r) || len > r.maxlen ||
+    if (len > r.maxlen ||
         (r.is_reduce && len % dtype_size(r.dtype) != 0))
       return {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
+    // Landing holds an inflight ref on the target MR for the duration
+    // of the write (see EmuEngine::landing_begin).
+    if (!eng_->landing_begin(r.mr))
+      return {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
+    DmaGuard guard{r.mr};
+    (void)guard;
     if (r.is_reduce)
       par_reduce(r.dst, data, len / dtype_size(r.dtype), r.dtype, r.red_op);
     else
@@ -640,12 +681,15 @@ class EmuQp : public Qp {
   // reduction, no scratch allocation. Returns false only on
   // connection loss.
   bool land_stream_wc(const PostedRecv &r, uint64_t len, tdr_wc *wc) {
-    if (!recv_target_valid(r) || len > r.maxlen ||
-        (r.is_reduce && len % dtype_size(r.dtype) != 0)) {
+    if (len > r.maxlen ||
+        (r.is_reduce && len % dtype_size(r.dtype) != 0) ||
+        !eng_->landing_begin(r.mr)) {
       if (!drain(len)) return false;
       *wc = {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
       return true;
     }
+    DmaGuard guard{r.mr};
+    (void)guard;
     if (!r.is_reduce) {
       if (!read_full(fd_, r.dst, len)) return false;
     } else {
@@ -672,11 +716,14 @@ class EmuQp : public Qp {
   // Returns whether the data movement succeeded (the ack status).
   bool land_cma_wc(const PostedRecv &r, uint64_t src, uint64_t len,
                    tdr_wc *wc) {
-    if (!recv_target_valid(r) || len > r.maxlen ||
-        (r.is_reduce && len % dtype_size(r.dtype) != 0)) {
+    if (len > r.maxlen ||
+        (r.is_reduce && len % dtype_size(r.dtype) != 0) ||
+        !eng_->landing_begin(r.mr)) {
       *wc = {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
       return true;  // desc mode: nothing on the wire to drain
     }
+    DmaGuard guard{r.mr};
+    (void)guard;
     bool ok;
     if (!r.is_reduce)
       ok = par_cma_copy_from(peer_pid_, r.dst, src, len);

@@ -153,6 +153,15 @@ int tdr_ring_register(tdr_ring *r, void *base, size_t len) {
   auto it = r->registered.find(key);
   if (it != r->registered.end()) {
     if (tdr_mr_len(it->second) >= len) return 0;
+    if (r->borrowed.count(key)) {
+      // The key holds an ADOPTED (caller-owned) MR: deregistering it
+      // here would double-free when the owner deregisters, and
+      // silently replacing it would orphan the owner's zero-copy
+      // binding. The owner must drop_buffer() first.
+      tdr::set_error(
+          "ring_register: key holds an adopted MR (drop it first)");
+      return -1;
+    }
     tdr_dereg_mr(it->second);
     r->registered.erase(it);
   }

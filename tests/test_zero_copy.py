@@ -21,7 +21,7 @@ from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
 from rocnrdma_tpu.collectives.staging import staging
 from rocnrdma_tpu.collectives.world import local_worlds
 from rocnrdma_tpu.hbm.registry import (DeviceArena, FakeHBMExporter,
-                                       HbmError, device_ndarray)
+                                       HbmError, as_ndarray, device_ndarray)
 from rocnrdma_tpu.transport.engine import TransportError
 
 from test_transport import free_port
@@ -168,6 +168,61 @@ def test_arena_tree_coalesces_to_one_ring_op():
     close_all(worlds, shims)
     for a in arenas:
         a.free()
+
+
+def test_live_gap_between_leaves_not_coalesced():
+    """Two device leaves with LIVE data in the gap between them must
+    reduce as separate ops — coalescing would overwrite the gap bytes
+    with the cross-rank sum (silent corruption). Only exporter-proven
+    dead padding (DeviceArena alignment gaps) may be merged across."""
+    worlds, exporters, shims = make_world2()
+    vas = [exporters[r].alloc(4096) for r in range(2)]
+    trees, guards = [], []
+    for r in range(2):
+        a = as_ndarray(vas[r], (25,), np.float32)         # [0, 100)
+        g = as_ndarray(vas[r] + 100, (28,), np.uint8)     # live bytes
+        b = as_ndarray(vas[r] + 128, (25,), np.float32)   # [128, 228)
+        a[:] = r + 1
+        b[:] = 10.0 * (r + 1)
+        g[:] = 77
+        trees.append([a, b])
+        guards.append(g)
+
+    with staging.expect_zero():
+        run_ranks(worlds, lambda w, r: shims[r](trees[r]))
+
+    for r in range(2):
+        np.testing.assert_allclose(trees[r][0], np.full(25, 3.0))
+        np.testing.assert_allclose(trees[r][1], np.full(25, 30.0))
+        assert (guards[r] == 77).all(), "live gap bytes were corrupted"
+        assert len(shims[r]._regs) == 2, "live gap was coalesced across"
+    close_all(worlds, shims)
+    for r in range(2):
+        exporters[r].free(vas[r])
+
+
+def test_ring_register_over_adopted_mr_rejected():
+    """Re-registering a larger buffer at a key holding an ADOPTED
+    (caller-owned) MR must fail instead of deregistering the owner's
+    MR (which would double-free on the owner's deregister)."""
+    from rocnrdma_tpu.transport.engine import Engine, Ring, loopback_pair
+
+    e = Engine("emu")
+    a, b = loopback_pair(e, free_port())
+    ring = Ring(e, a, b, 0, 2)
+    buf = np.zeros(1024, dtype=np.float32)
+    mr = e.reg_mr(buf)
+    ring.adopt_mr(buf.ctypes.data, mr)
+    bigger = as_ndarray(buf.ctypes.data, (2048,), np.float32)
+    with pytest.raises(TransportError, match="adopted"):
+        ring.register_buffer(bigger)
+    # The adopted MR is untouched: dropping + owner dereg still works.
+    ring.drop_buffer(buf.ctypes.data)
+    mr.deregister()
+    ring.destroy()
+    a.close()
+    b.close()
+    e.close()
 
 
 def test_tied_leaf_reduced_once():
