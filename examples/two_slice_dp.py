@@ -56,12 +56,18 @@ def run_slice(rank: int, world: int, base_port: int, peers, args):
     from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
     from rocnrdma_tpu.collectives.staging import staging
     from rocnrdma_tpu.collectives.world import RingWorld
+    from rocnrdma_tpu.hbm.tpu import TPUExporter
     from rocnrdma_tpu.parallel.trainer import Trainer
     from rocnrdma_tpu.transport.engine import Engine
 
     world_obj = RingWorld(Engine(args.engine), rank, world, base_port,
                           peers=peers)
-    sync = CrossSliceAllReduce(world_obj, mean=True)
+    # The TPUExporter lets gradient jax.Arrays ride the zero-copy path
+    # (in-place ring on the XLA buffers, no host staging) wherever
+    # their shard buffers are transport-addressable; other leaves fall
+    # back to the staged path with their bytes accounted.
+    sync = CrossSliceAllReduce(world_obj, exporter=TPUExporter(),
+                               mean=True)
     trainer = Trainer(args.model, parse_mesh(args.mesh),
                       cross_slice_sync=sync)
 

@@ -23,16 +23,37 @@ Data path per pytree, in preference order:
      only. If the owner frees the memory mid-collective, the exporter's
      free_callback invalidates the MR and the collective fails with a
      transport error instead of touching reclaimed pages.
-  2. **Staged fallback** for leaves the exporter does not own (or with
+  2. **Zero-copy for jax.Array leaves** (with a ``TPUExporter``): a
+     fully-addressable array whose shard buffers are CPU-addressable
+     (``unsafe_buffer_pointer``) is adopted per shard, registered
+     through the same pipeline (dma-buf preferred, legacy ``reg_mr``
+     on the VA when libtpu export is unavailable), and reduced IN
+     PLACE on the XLA buffer itself — zero staged bytes. The input
+     tree's buffers are therefore **consumed** (donation semantics):
+     after the call every rank's leaf holds the reduced value, and the
+     pre-reduce values are gone. That is exactly what gradient
+     averaging wants; callers needing the originals must copy first.
+     On a real TPU backend the shard pointers are HBM device addresses
+     the host transport cannot touch, so this path disengages and the
+     staged fallback carries those leaves until libtpu exposes dma-buf
+     export (see ``TPUExporter.export_dmabuf``).
+  3. **Staged fallback** for leaves the exporter does not own (or with
      no exporter at all): leaves are grouped by dtype and packed into
      one flat pinned host buffer per dtype, ring allreduce on the host
      buffer, then scattered back — with every staged byte charged to
      ``collectives.staging`` so the distance from the zero-staging
      target is always visible.
+
+Schedule order (the SPMD contract across ranks): coalesced
+numpy-exporter regions first (sorted by VA — identical relative layout
+is guaranteed by same-order arena allocation), then jax.Array regions
+in TREE order (VAs are allocator-assigned and DIFFER across ranks, so
+VA order would desynchronize the ring), then the staged groups.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -41,8 +62,15 @@ from rocnrdma_tpu.collectives.staging import staging
 from rocnrdma_tpu.collectives.world import RingWorld
 from rocnrdma_tpu.hbm.registry import (HbmError, MemoryExporter,
                                        RegistrationManager, as_ndarray)
-from rocnrdma_tpu.transport.engine import RED_SUM
+from rocnrdma_tpu.transport.engine import RED_SUM, _NUMPY_DTYPE_MAP
 from rocnrdma_tpu.utils.trace import trace
+
+# Bound on cached zero-copy registrations. XLA's allocator reuses
+# gradient buffers across steps, so in steady state the cache is small
+# and every step is a dictionary hit; the cap only matters when
+# addresses churn (shape changes, allocator growth) — eviction then
+# drops the least-recently-registered unused entries.
+_REG_CACHE_MAX = 128
 
 # Adjacent device leaves (same dtype, same allocation) are coalesced
 # into one ring op across alignment gaps up to this many bytes — a
@@ -138,10 +166,73 @@ class CrossSliceAllReduce:
         if self._regmgr is None:
             self._regmgr = RegistrationManager(self.world.engine,
                                                self.exporter)
+        # Purge stale cache entries at the same VA with a DIFFERENT
+        # size (the allocator reused the buffer for a differently-
+        # shaped leaf). Their ring binding is about to be superseded by
+        # this registration; evicting them later would drop the new
+        # ring entry by VA.
+        for key in [k for k in self._regs if k[0] == va and k[1] != nbytes]:
+            # Keep the adoption: this VA is being re-registered for the
+            # current leaf right below.
+            self._drop_cached(key, forget_adoption=False)
         reg = self._regmgr.register(va, nbytes)  # dma-buf preferred
         self.world.ring.adopt_mr(va, reg.mr)
         self._regs[(va, nbytes)] = reg
         trace.event("xslice.zero_copy_reg", va=va, bytes=nbytes)
+
+    def _drop_cached(self, key: Tuple[int, int],
+                     forget_adoption: bool = True) -> None:
+        """Tear down one cached registration (ring binding, MR, pin,
+        and — for adopting exporters — the pin-free adoption record)."""
+        reg = self._regs.pop(key)
+        try:
+            self.world.ring.drop_buffer(key[0])
+        except Exception:
+            pass  # ring entry may have been superseded or dropped
+        try:
+            self._regmgr.deregister(reg)
+        except HbmError:
+            pass  # already revoked
+        forget = getattr(self.exporter, "forget", None)
+        if forget_adoption and forget is not None:
+            try:
+                forget(key[0])
+            except HbmError:
+                pass  # another registration still pins the range
+
+    def _evict_cache(self, used: set) -> None:
+        over = len(self._regs) - _REG_CACHE_MAX
+        if over <= 0:
+            return
+        for key in [k for k in self._regs if k not in used][:over]:
+            self._drop_cached(key)
+            trace.event("xslice.zero_copy_evict", va=key[0], bytes=key[1])
+
+    def _jax_leaf_regions(self, leaf):
+        """Per-shard (va, nbytes, shard_buffer) for a jax.Array leaf
+        eligible for in-place zero-copy, or None (→ staged path).
+
+        Requires an adopting exporter (``TPUExporter``): each shard's
+        VA range is adopted (holding the buffer ref until ``unhold``)
+        so the registration pipeline can classify and pin it."""
+        if self.exporter is None or isinstance(leaf, np.ndarray):
+            return None
+        adopt = getattr(self.exporter, "adopt_region", None)
+        if adopt is None or not hasattr(leaf, "addressable_shards"):
+            return None
+        if leaf.nbytes == 0 or str(leaf.dtype) not in _NUMPY_DTYPE_MAP:
+            return None
+        from rocnrdma_tpu.hbm.tpu import shard_regions
+
+        # The producer (XLA async dispatch) must be done writing the
+        # buffer before the transport reduces it in place.
+        leaf.block_until_ready()
+        regions = shard_regions(leaf)
+        if not regions:
+            return None
+        for va, nbytes, buf in regions:
+            adopt(va, nbytes, owner=buf)
+        return regions
 
     def _zero_copy(self, leaf: np.ndarray, va: int, nbytes: int,
                    op: int = RED_SUM) -> None:
@@ -210,29 +301,75 @@ class CrossSliceAllReduce:
 
         # Zero-copy pass: device-resident leaves reduce in place.
         # Aliased leaves (the same buffer appearing twice — tied
-        # weights) reduce once; adjacent regions coalesce into single
-        # ring ops (see _coalesce).
+        # weights) reduce once; adjacent numpy-exporter regions
+        # coalesce into single ring ops (see _coalesce); jax.Array
+        # regions run in tree order (see module docstring).
         staged_idx: List[int] = []
         dev_regions: List[Tuple[int, int, Any]] = []
+        jax_ops: List[Tuple[int, int, Any]] = []
         seen: set = set()
+        used_keys: set = set()
         for i, leaf in enumerate(leaves):
             dev = self._device_leaf(leaf)
-            if dev is None:
-                staged_idx.append(i)
+            if dev is not None:
+                n_zero_copy += 1
+                if dev in seen:
+                    continue
+                seen.add(dev)
+                dev_regions.append((dev[0], dev[1], leaf))
                 continue
-            n_zero_copy += 1
-            if dev in seen:
+            regions = self._jax_leaf_regions(leaf)
+            if regions is not None:
+                n_zero_copy += 1
+                for va, nbytes, buf in regions:
+                    if (va, nbytes) in seen:
+                        continue  # tied leaves: reduce once, in place
+                    seen.add((va, nbytes))
+                    jax_ops.append((va, nbytes, buf))
                 continue
-            seen.add(dev)
-            dev_regions.append((dev[0], dev[1], leaf))
-        for va, nbytes, arr in self._coalesce(dev_regions):
-            self._zero_copy(arr, va, nbytes)
+            staged_idx.append(i)
+        coalesced = self._coalesce(dev_regions)
 
-        # Staged fallback for everything else, packed per dtype.
+        # Staged groups, keyed by dtype in first-occurrence order (the
+        # same deterministic order on every rank).
         groups: Dict[str, List[int]] = {}
         for i in staged_idx:
             groups.setdefault(str(leaves[i].dtype), []).append(i)
 
+        # Fail fast on SPMD divergence BEFORE posting any ring op: all
+        # ranks must run the identical op sequence (sizes, dtypes,
+        # residency) or the ring desynchronizes into a stall.
+        import hashlib
+
+        sched = [f"world={self.world.world}",
+                 f"chunk={os.environ.get('TDR_RING_CHUNK', '')}",
+                 f"mean={int(self.mean)}"]
+        sched += [f"z:{nbytes}:{arr.dtype}" for _, nbytes, arr in coalesced]
+        sched += [f"j:{nbytes}:{buf.dtype}" for _, nbytes, buf in jax_ops]
+        sched += [f"s:{d}:{sum(int(leaves[i].size) for i in idxs)}"
+                  for d, idxs in groups.items()]
+        describe = " ".join(sched)
+        check = getattr(self.world, "check_schedule", None)
+        if check is not None:
+            check(hashlib.sha256(describe.encode()).digest(), describe)
+
+        for va, nbytes, arr in coalesced:
+            self._zero_copy(arr, va, nbytes)
+            used_keys.add((va, nbytes))
+        unhold = getattr(self.exporter, "unhold", None)
+        for va, nbytes, buf in jax_ops:
+            # Flat elementwise view over the shard's XLA buffer — the
+            # reduction happens directly in device memory.
+            view = as_ndarray(
+                va, (nbytes // np.dtype(buf.dtype).itemsize,), buf.dtype)
+            self._zero_copy(view, va, nbytes)
+            used_keys.add((va, nbytes))
+            if unhold is not None:
+                # Steady state: let XLA reuse the buffer next step so
+                # the registration cache converges (see TPUExporter).
+                unhold(va)
+
+        # Staged fallback for everything else, packed per dtype.
         for dtype_str, idxs in groups.items():
             host_parts = [np.asarray(jax.device_get(leaves[i]))
                           for i in idxs]
@@ -265,6 +402,7 @@ class CrossSliceAllReduce:
                     # dp×tp mesh doesn't funnel gradients through one
                     # device.
                     out[i] = jax.device_put(piece, leaves[i].sharding)
+        self._evict_cache(used_keys)
         trace.event("xslice.allreduce", leaves=len(leaves),
                     zero_copy=n_zero_copy, staged=len(staged_idx))
         return jax.tree_util.tree_unflatten(treedef, out)
@@ -285,16 +423,8 @@ class CrossSliceAllReduce:
     def close(self) -> None:
         """Release the zero-copy registrations (unadopt from the ring,
         then unpin). Call before tearing down the world."""
-        for (va, _), reg in list(self._regs.items()):
-            try:
-                self.world.ring.drop_buffer(va)
-            except Exception:
-                pass  # ring may already be gone
-            try:
-                self._regmgr.deregister(reg)
-            except HbmError:
-                pass  # already revoked
-        self._regs.clear()
+        for key in list(self._regs):
+            self._drop_cached(key, forget_adoption=False)
         if self._regmgr is not None:
             self._regmgr.close()
 

@@ -13,11 +13,20 @@ threads), multi-process single-host, and multi-host (pass ``peers``).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import List, Optional, Sequence
 
-from rocnrdma_tpu.transport.engine import Engine, QueuePair, Ring, RED_SUM
+import numpy as np
+
+from rocnrdma_tpu.transport.engine import (Engine, QueuePair, Ring, RED_SUM,
+                                           TransportError)
 from rocnrdma_tpu.utils.trace import trace
+
+# wr_id tags for the schedule-digest exchange — distinct from the
+# ring's kWrRecv/kWrSend tag space (0x5245/0x5345 << 48).
+_WR_DIGEST_RECV = 0x4447 << 48
+_WR_DIGEST_SEND = (0x4447 << 48) | 1
 
 
 class RingWorld:
@@ -62,14 +71,87 @@ class RingWorld:
             raise TimeoutError("left neighbor never connected")
         self.left_qp = accepted[0]
         self.ring = Ring(engine, self.left_qp, self.right_qp, rank, world)
+        # Schedule-digest buffers (check_schedule), registered lazily.
+        self._dg_send = self._dg_recv = None
+        self._dg_smr = self._dg_rmr = None
         trace.event("world.up", rank=rank, world=world)
 
     def allreduce(self, array, op: int = RED_SUM) -> None:
         """In-place ring allreduce of a C-contiguous numpy array."""
         self.ring.allreduce(array, op)
 
+    def _dg_hop(self, send_len: int, timeout: int, what: str) -> None:
+        """One neighbor hop of the digest protocol: recv ``send_len``
+        bytes from the left while sending the same from the right."""
+        self.left_qp.post_recv(self._dg_rmr, 0, send_len,
+                               wr_id=_WR_DIGEST_RECV)
+        self.right_qp.post_send(self._dg_smr, 0, send_len,
+                                wr_id=_WR_DIGEST_SEND)
+        if not self.right_qp.wait(_WR_DIGEST_SEND, timeout_ms=timeout).ok:
+            raise TransportError(f"schedule {what} send failed")
+        if not self.left_qp.wait(_WR_DIGEST_RECV, timeout_ms=timeout).ok:
+            raise TransportError(f"schedule {what} recv failed")
+
+    def check_schedule(self, digest: bytes, describe: str = "") -> None:
+        """Fail fast on SPMD schedule divergence.
+
+        Round 1: each rank sends its 32-byte schedule digest to its
+        right neighbor and compares the one received from its left —
+        on a CLOSED ring, every pair matching implies all ranks match.
+        Round 2: a status byte (1 = my pair matched) circulates
+        world-1 hops carrying the ring-wide minimum, so EVERY rank —
+        not just the divergent pair — raises immediately instead of
+        posting into a dead collective and stalling out the ~30 s ring
+        timeout (the failure mode the reference world debugged from
+        dmesg).
+
+        TDR_NO_SCHED_CHECK=1 skips only the comparison/raise; the
+        messages are still exchanged on every rank so a per-rank env
+        divergence can never desynchronize the QP message stream
+        (a skipped exchange would let the neighbor's digest frame be
+        consumed by a gradient recv as data).
+        """
+        if self._dg_smr is None:
+            self._dg_send = np.zeros(32, dtype=np.uint8)
+            self._dg_recv = np.zeros(32, dtype=np.uint8)
+            self._dg_smr = self.engine.reg_mr(self._dg_send)
+            self._dg_rmr = self.engine.reg_mr(self._dg_recv)
+        assert len(digest) == 32
+        timeout = int(os.environ.get("TDR_RING_TIMEOUT_MS", "30000"))
+        check = os.environ.get("TDR_NO_SCHED_CHECK", "0") in ("", "0")
+
+        self._dg_recv[:] = 0
+        self._dg_send[:] = np.frombuffer(digest, dtype=np.uint8)
+        self._dg_hop(32, timeout, "digest")
+        got = self._dg_recv.tobytes()
+        ok = got == digest
+
+        status = 1 if (ok or not check) else 0
+        for _ in range(self.world - 1):
+            self._dg_send[0] = status
+            self._dg_hop(1, timeout, "status")
+            status = min(status, int(self._dg_recv[0]))
+        if not check:
+            return
+        if not ok:
+            raise TransportError(
+                f"SPMD schedule mismatch on rank {self.rank}: left "
+                f"neighbor's collective layout digest {got.hex()[:16]}… "
+                f"differs from local {digest.hex()[:16]}… — all ranks "
+                "must call with identical tree structure, dtypes, "
+                f"shapes AND residency. Local layout: {describe}")
+        if status == 0:
+            raise TransportError(
+                f"SPMD schedule mismatch reported by a peer (rank "
+                f"{self.rank}'s own pair matched); aborting the "
+                "collective before posting. Local layout: " + describe)
+
     def close(self) -> None:
         self.ring.destroy()
+        for mr in (self._dg_smr, self._dg_rmr):
+            if mr is not None:
+                mr.deregister()
+        self._dg_smr = self._dg_rmr = None
         self.left_qp.close()
         self.right_qp.close()
 

@@ -68,6 +68,46 @@ def buffer_pointer(arr) -> int:
     return _synthetic_va(arr.nbytes)
 
 
+def shard_regions(arr):
+    """Per-shard (va, nbytes, shard_buffer) for a fully-addressable
+    jax.Array whose buffers are CPU-addressable, or None.
+
+    This is the jax.Array analogue of the reference's GPU-VA
+    classification (``is_gpu_address``, amdp2p.c:127): a region the
+    transport can register and DMA in place. Returns None — sending the
+    caller to the staged path — when:
+
+    - the PJRT plugin hides raw pointers (``unsafe_buffer_pointer``
+      unavailable: the axon tunnel case), or
+    - the buffers are not CPU-addressable (a real TPU backend: its HBM
+      pointers are device addresses the host transport cannot touch —
+      the data path there needs libtpu dma-buf export, the external
+      constraint recorded at ``TPUExporter.export_dmabuf``), or
+    - the array is not fully addressable from this process.
+
+    Shard order follows ``addressable_shards`` (device order), which is
+    identical across ranks running identical meshes — the SPMD
+    schedule-matching contract extends to shard order.
+    """
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards or not getattr(arr, "is_fully_addressable", False):
+        return None
+    try:
+        platforms = {d.platform for d in arr.devices()}
+    except Exception:
+        return None
+    if platforms != {"cpu"}:
+        return None
+    out = []
+    try:
+        for s in shards:
+            buf = s.data
+            out.append((buf.unsafe_buffer_pointer(), buf.nbytes, buf))
+    except Exception:
+        return None
+    return out
+
+
 class TPUExporter(MemoryExporter):
     """Pin-lifecycle provider for JAX arrays.
 
@@ -92,6 +132,44 @@ class TPUExporter(MemoryExporter):
             self._adopted[va] = (arr, nbytes)
         trace.event("tpu.adopt", va=va, bytes=nbytes)
         return va
+
+    def adopt_region(self, va: int, nbytes: int, owner=None) -> None:
+        """Adopt (or refresh) an explicit VA range — the per-shard form
+        ``shard_regions`` feeds. ``owner`` (the shard buffer) is held
+        so XLA cannot free it while the range is being registered;
+        ``unhold`` drops the ref once steady state is reached."""
+        with self._lock:
+            prev = self._adopted.get(va)
+            self._adopted[va] = (owner, max(nbytes, prev[1] if prev else 0))
+        trace.event("tpu.adopt_region", va=va, bytes=nbytes)
+
+    def unhold(self, va: int) -> None:
+        """Drop the owner ref for an adopted range but KEEP the range
+        adopted and any registration over it warm.
+
+        This is the steady-state contract for per-step gradient
+        buffers: holding the array ref across steps would force XLA's
+        allocator to place every step's gradients at fresh addresses
+        (the cached registration would never hit). Dropping the ref
+        lets the allocator reuse the same buffer, so the (va, nbytes)
+        registration cache converges — the front-loaded-registration
+        invariant (SURVEY.md §3.3) for arrays that are re-materialized
+        every step. The registered range stays mapped (CPU allocators
+        recycle, they don't unmap arena pages); the collective only
+        ever touches it through a live leaf that currently occupies it."""
+        with self._lock:
+            if va in self._adopted:
+                self._adopted[va] = (None, self._adopted[va][1])
+
+    def forget(self, va: int) -> None:
+        """Remove an adopted range with NO revocation — only legal when
+        no pins cover it (registration already torn down). Used by the
+        collective's cache eviction; ``release`` is the revoking form."""
+        with self._lock:
+            if any(va <= p.va < va + self._adopted.get(va, (None, 0))[1]
+                   and not p._released for (p, _, _) in self._pins.values()):
+                raise HbmError(f"forget of {va:#x} with live pins")
+            self._adopted.pop(va, None)
 
     def release(self, va: int) -> None:
         with self._lock:

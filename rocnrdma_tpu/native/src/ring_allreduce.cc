@@ -152,16 +152,17 @@ int tdr_ring_register(tdr_ring *r, void *base, size_t len) {
   uint64_t key = reinterpret_cast<uint64_t>(base);
   auto it = r->registered.find(key);
   if (it != r->registered.end()) {
-    if (tdr_mr_len(it->second) >= len) return 0;
     if (r->borrowed.count(key)) {
-      // The key holds an ADOPTED (caller-owned) MR: deregistering it
-      // here would double-free when the owner deregisters, and
-      // silently replacing it would orphan the owner's zero-copy
-      // binding. The owner must drop_buffer() first.
+      // The key holds an ADOPTED (caller-owned) MR: silently
+      // succeeding would bind this caller to the owner's MR (its
+      // later unregister then orphans the owner's zero-copy binding),
+      // and replacing/deregistering would double-free when the owner
+      // deregisters. The owner must drop_buffer() first.
       tdr::set_error(
           "ring_register: key holds an adopted MR (drop it first)");
       return -1;
     }
+    if (tdr_mr_len(it->second) >= len) return 0;
     tdr_dereg_mr(it->second);
     r->registered.erase(it);
   }

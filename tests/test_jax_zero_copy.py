@@ -1,0 +1,256 @@
+"""Zero-copy collective path for real jax.Array leaves.
+
+The reference's value proposition is zero software on the hot path for
+the CONSUMER's buffers (amdp2p.c:219-264, README.md:64) — here the
+consumer is JAX: gradient pytrees of jax.Arrays must ride the
+registered-MR in-place ring with zero host staging, not just numpy
+views on exporter memory. On the CPU backend the shard buffers are
+host-addressable (``unsafe_buffer_pointer``), so the full chain —
+jax.Array → adopt → register (legacy reg_mr, since libtpu lacks
+dma-buf export) → ring adopt_mr → in-place allreduce — runs
+hardware-free, which is exactly how it will run on TPU the day the
+dma-buf export lands.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+from rocnrdma_tpu.collectives.staging import staging
+from rocnrdma_tpu.collectives.world import local_worlds
+from rocnrdma_tpu.hbm.tpu import TPUExporter, shard_regions
+from rocnrdma_tpu.utils.trace import trace
+
+from test_transport import free_port
+from test_collectives import run_ranks
+
+
+def make_world2():
+    worlds = local_worlds(2, free_port() + 200)
+    shims = [CrossSliceAllReduce(worlds[r], exporter=TPUExporter())
+             for r in range(2)]
+    return worlds, shims
+
+
+def close_all(worlds, shims):
+    for s in shims:
+        s.close()
+    for w in worlds:
+        w.close()
+
+
+def test_jax_tree_zero_copy_expect_zero():
+    """A pytree of committed jax.Arrays allreduces IN PLACE with zero
+    host staging — the north-star chain for the actual consumer."""
+    worlds, shims = make_world2()
+    trees = []
+    for r in range(2):
+        k = jax.random.PRNGKey(r)
+        trees.append({
+            "w": jax.device_put(jax.random.normal(k, (64, 33))),
+            "b": jnp.full((257,), float(r + 1)),
+            "n": jnp.full((50,), r + 1, dtype=jnp.int32),
+        })
+    expect = {k: np.asarray(trees[0][k]) + np.asarray(trees[1][k])
+              for k in trees[0]}
+
+    outs = [None, None]
+    staging.reset()
+    with staging.expect_zero():
+        run_ranks(worlds, lambda w, r: outs.__setitem__(r, shims[r](trees[r])))
+
+    for r in range(2):
+        for k in expect:
+            np.testing.assert_allclose(np.asarray(outs[r][k]), expect[k],
+                                       rtol=1e-5, atol=1e-5)
+            # In-place donation semantics: the INPUT leaf holds the
+            # reduced value too (same buffer).
+            np.testing.assert_allclose(np.asarray(trees[r][k]), expect[k],
+                                       rtol=1e-5, atol=1e-5)
+    ev = [kv for _, name, kv in trace.events()
+          if name == "xslice.allreduce"]
+    assert ev and all(e["zero_copy"] == 3 and e["staged"] == 0 for e in ev)
+    close_all(worlds, shims)
+
+
+def test_jax_sharded_array_zero_copy():
+    """A jax.Array sharded over multiple (virtual CPU) devices reduces
+    shard-by-shard in place — the dp×tp mesh case."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 devices")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devs[:2]), ("x",))
+    sharding = NamedSharding(mesh, P("x"))
+    worlds, shims = make_world2()
+    arrs = [jax.device_put(jnp.arange(128, dtype=jnp.float32) * (r + 1),
+                           sharding) for r in range(2)]
+    assert len(arrs[0].addressable_shards) == 2
+    want = np.arange(128, dtype=np.float32) * 3
+
+    with staging.expect_zero():
+        run_ranks(worlds, lambda w, r: shims[r](arrs[r]))
+
+    for r in range(2):
+        np.testing.assert_allclose(np.asarray(arrs[r]), want, rtol=1e-6)
+        # one registration per shard
+        assert len(shims[r]._regs) == 2
+    close_all(worlds, shims)
+
+
+def test_jax_zero_copy_registration_cached():
+    """Second allreduce on the same arrays hits the registration cache
+    (front-loaded registration invariant holds for jax leaves)."""
+    worlds, shims = make_world2()
+    arrs = [jnp.ones((4096,)) * (r + 1) for r in range(2)]
+    run_ranks(worlds, lambda w, r: shims[r](arrs[r]))
+    regs_after_first = [dict(s._regs) for s in shims]
+    with staging.expect_zero():
+        run_ranks(worlds, lambda w, r: shims[r](arrs[r]))
+    for r in range(2):
+        assert shims[r]._regs == regs_after_first[r], "re-registered"
+        np.testing.assert_allclose(np.asarray(arrs[r]), np.full(4096, 6.0))
+    close_all(worlds, shims)
+
+
+def test_jax_zero_copy_mean_and_int():
+    worlds, shims = make_world2()
+    for s in shims:
+        s.mean = True
+    arrs = [{"f": jnp.full((1000,), float(r + 1)),
+             "i": jnp.full((100,), (r + 1) * 2, dtype=jnp.int32)}
+            for r in range(2)]
+    with staging.expect_zero():
+        run_ranks(worlds, lambda w, r: shims[r](arrs[r]))
+    for r in range(2):
+        np.testing.assert_allclose(np.asarray(arrs[r]["f"]),
+                                   np.full(1000, 1.5))
+        np.testing.assert_array_equal(np.asarray(arrs[r]["i"]),
+                                      np.full(100, 3, dtype=np.int32))
+    close_all(worlds, shims)
+
+
+def test_shard_regions_rejects_foreign():
+    """Non-CPU-addressable / non-array inputs are classified out (the
+    ``is_gpu_address``-returns-0 analogue), sending them to staging."""
+    assert shard_regions(np.ones(4)) is None
+    arr = jnp.ones((8,))
+    regions = shard_regions(arr)
+    assert regions is not None and len(regions) == 1
+    va, nbytes, buf = regions[0]
+    assert nbytes == 32 and va != 0
+
+
+def test_schedule_mismatch_fails_fast():
+    """Ranks calling with different layouts (sizes/residency) get an
+    immediate TransportError from the schedule-digest handshake — not
+    a 30 s ring stall."""
+    import time
+
+    from rocnrdma_tpu.transport.engine import TransportError
+
+    worlds, shims = make_world2()
+    trees = [jnp.ones((100,)), jnp.ones((200,))]  # divergent shapes
+    errs = [None, None]
+
+    def step(w, r):
+        try:
+            shims[r](trees[r])
+        except TransportError as e:
+            errs[r] = e
+
+    t0 = time.perf_counter()
+    run_ranks(worlds, step)
+    dt = time.perf_counter() - t0
+    assert dt < 10, f"mismatch took {dt:.1f}s — not fail-fast"
+    assert all(errs), errs
+    for e in errs:
+        assert "schedule mismatch" in str(e)
+        assert "Local layout" in str(e)
+    close_all(worlds, shims)
+
+
+def test_schedule_mismatch_world3_all_ranks_fail_fast():
+    """world>2: ranks NOT adjacent to the divergence learn of it from
+    the circulated status byte and abort before posting — nobody
+    stalls out the ring timeout."""
+    import time
+
+    from rocnrdma_tpu.transport.engine import TransportError
+
+    worlds = local_worlds(3, free_port() + 300)
+    shims = [CrossSliceAllReduce(worlds[r]) for r in range(3)]
+    trees = [jnp.ones((100,)), jnp.ones((100,)), jnp.ones((999,))]
+    errs = [None] * 3
+
+    def step(w, r):
+        try:
+            shims[r](trees[r])
+        except TransportError as e:
+            errs[r] = e
+
+    t0 = time.perf_counter()
+    run_ranks(worlds, step)
+    dt = time.perf_counter() - t0
+    assert dt < 10, f"took {dt:.1f}s — some rank stalled"
+    assert all(errs), errs
+    # Rank 1 (left neighbor rank 0 matches it) learns via the status.
+    assert "reported by a peer" in str(errs[1])
+    close_all(worlds, shims)
+
+
+def test_trainer_two_slice_zero_copy_loss_parity():
+    """Two DP 'slices' (threads) whose gradient sync rides the
+    zero-copy jax path train IDENTICALLY to one process on the
+    combined batch — loss and params parity — with zero staged bytes
+    and zero_copy>0 on every sync (VERDICT round-2 task 1 done-
+    criterion)."""
+    from rocnrdma_tpu.parallel.trainer import Trainer
+
+    worlds, shims = make_world2()
+    for s in shims:
+        s.mean = True
+    trainers = [Trainer("llama-tiny", {"dp": 1, "tp": 1},
+                        cross_slice_sync=shims[r], seed=0)
+                for r in range(2)]
+    ref = Trainer("llama-tiny", {"dp": 1, "tp": 1}, seed=0)
+
+    rng = np.random.default_rng(42)
+    steps = 3
+    batches = [rng.integers(0, 255, (2, 2, 17)).astype(np.int32)
+               for _ in range(steps)]  # [step][slice, batch, seq]
+
+    losses = np.zeros((steps, 2))
+    ref_losses = np.zeros(steps)
+    staging.reset()
+    trace.reset()
+    for t in range(steps):
+        def step(w, r, t=t):
+            losses[t, r] = trainers[r].step(batches[t][r])
+
+        run_ranks(worlds, step)
+        ref_losses[t] = ref.step(
+            batches[t].reshape(-1, batches[t].shape[-1]))
+
+    # Equal-sized shards + token-mean loss: mean of slice losses ==
+    # combined-batch loss, and synced mean grads == combined grads.
+    np.testing.assert_allclose(losses.mean(axis=1), ref_losses,
+                               rtol=2e-4, atol=2e-5)
+    ref_leaves = jax.tree_util.tree_leaves(ref.params)
+    for r in range(2):
+        got = jax.tree_util.tree_leaves(trainers[r].params)
+        for a, b in zip(got, ref_leaves):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+    assert staging.bytes == 0, "gradient sync staged host bytes"
+    evs = [kv for _, name, kv in trace.events()
+           if name == "xslice.allreduce"]
+    assert evs and all(e["zero_copy"] > 0 and e["staged"] == 0
+                       for e in evs), evs
+    close_all(worlds, shims)
