@@ -10,14 +10,29 @@ north-star target, 90% of 100 Gb/s NIC line rate (11.25 GB/s bus
 bandwidth), since the reference publishes no numbers of its own
 (BASELINE.md "Reference-published numbers: none").
 
-Details carried alongside: ib_write_bw-style point-to-point loopback
-(config 0), and — when a real TPU is reachable — the device↔host
-staging bandwidth of the chip (the path whose elimination is the
-whole point) plus a model-forward sanity timing.
+Carried alongside, so the headline number is judgeable:
+
+- **Machine roofline** (``roofline_*``): single-core memcpy and f32
+  fold (a += b) bandwidth of THIS host. On the 1-vCPU CI box both
+  ring ranks and the emulated NIC share one core, and every byte of
+  the fused world-2 exchange must pass through the fold kernel at
+  least once — the allreduce cannot beat the fold rate.
+  ``vs_roofline`` = headline / fold-roofline is the fraction of what
+  this machine physically allows (vs_baseline measures distance to a
+  100 Gb/s NIC this host does not have).
+- **Point-to-point**: ib_write_bw-style loopback (config 0) plus the
+  config-2 4 B–1 GiB message sweep (peak + small-message latency).
+- **Real-TPU sub-benches** when the device tunnel is reachable:
+  H2D/D2H staging bandwidth (the path whose elimination is the whole
+  point), Llama-3-1B forward tokens/s, and an MFU estimate against
+  the chip's peak. Unreachability is RECORDED (``details["tpu"]``),
+  never silently swallowed: the tunnel in this environment is flaky,
+  and "no numbers" must be distinguishable from "didn't try".
 """
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -28,6 +43,28 @@ import numpy as np
 
 # Bus-bandwidth target: 90% of 12.5 GB/s (100 Gb/s line rate).
 TARGET_BUS_GBPS = 0.9 * 12.5
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def bench_roofline(nbytes=256 << 20, iters=5):
+    """Single-core memcpy and f32 fold (a += b) GB/s — the memory
+    system's answer to 'how fast could ANY allreduce go here'."""
+    n = nbytes // 4
+    src = np.ones(n, dtype=np.float32)
+    dst = np.zeros(n, dtype=np.float32)
+    np.copyto(dst, src)  # warm/fault
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        np.copyto(dst, src)
+    memcpy = nbytes * iters / (time.perf_counter() - t0) / 1e9
+    dst[:] = 0.0
+    dst += src  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        dst += src
+    fold = nbytes * iters / (time.perf_counter() - t0) / 1e9
+    return round(memcpy, 3), round(fold, 3)
 
 
 def bench_p2p_write(size=1 << 30, iters=3):
@@ -96,6 +133,50 @@ def bench_allreduce(count=(1 << 30) // 4, world=2, iters=3):
     return nbytes * 2 * (world - 1) / world / dt / 1e9
 
 
+def bench_sweep(timeout_s=300):
+    """Config-2: the 4 B–1 GiB message-size sweep (peak bandwidth and
+    small-message latency) via the perftest-analogue tool."""
+    import socket
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "rocnrdma_tpu.tools.perf", "--loopback",
+             "--engine", "emu", "--op", "write", "--sizes", "4:1G",
+             "--iters", "4", "--port", str(port), "--json"],
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO)
+        for line in proc.stdout.splitlines():
+            if line.startswith("{"):
+                out = json.loads(line)
+                return {
+                    "peak_GBps": out["peak_GBps"],
+                    "lat_4B_us": out["sweep"][0]["lat_us"],
+                    "sweep": out["sweep"],
+                }
+        return {"error": (proc.stderr or "no JSON line").strip()[-300:]}
+    except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+# Known per-chip bf16 peaks (dense), TFLOPs. Overridable via
+# TDR_TPU_PEAK_TFLOPS when the device kind is missing or newer.
+_CHIP_PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+}
+
+_TPU_PROBE = r"""
+import json, sys
+import jax
+devs = jax.devices()
+print("TPUPROBE " + json.dumps(
+    [{"platform": d.platform, "kind": getattr(d, "device_kind", "?")}
+     for d in devs]))
+"""
+
 _TPU_SNIPPET = r"""
 import json, time, sys
 import numpy as np
@@ -103,62 +184,131 @@ import jax, jax.numpy as jnp
 
 out = {}
 devs = [d for d in jax.devices() if d.platform != "cpu"]
-if devs:
-    n = 256 * (1 << 20) // 4
-    host = np.ones(n, dtype=np.float32)
-    t0 = time.perf_counter()
-    dev = jax.device_put(host, devs[0]); dev.block_until_ready()
-    out["tpu_h2d_GBps"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
-    t0 = time.perf_counter()
-    _ = np.asarray(dev)
-    out["tpu_d2h_GBps"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
+if not devs:
+    print("TPUBENCH " + json.dumps({"error": "no accelerator devices"}))
+    raise SystemExit(0)
+dev = devs[0]
+out["device_kind"] = getattr(dev, "device_kind", "?")
 
-    sys.path.insert(0, %r)
-    from rocnrdma_tpu.models.llama import make_model, init_params
-    model = make_model("llama3-1b")
-    params = init_params(model, jax.random.PRNGKey(0))
-    tokens = jnp.ones((1, 2048), dtype=jnp.int32)
-    fwd = jax.jit(lambda p, t: model.apply(p, t))
+n = 256 * (1 << 20) // 4
+host = np.ones(n, dtype=np.float32)
+t0 = time.perf_counter()
+darr = jax.device_put(host, dev); darr.block_until_ready()
+out["tpu_h2d_GBps"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
+t0 = time.perf_counter()
+_ = np.asarray(darr)
+out["tpu_d2h_GBps"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
+
+sys.path.insert(0, %r)
+from rocnrdma_tpu.models.llama import make_model, init_params
+model = make_model("llama3-1b")
+params = init_params(model, jax.random.PRNGKey(0))
+n_params = model.cfg.param_count()
+seq = 2048
+tokens = jnp.ones((1, seq), dtype=jnp.int32)
+fwd = jax.jit(lambda p, t: model.apply(p, t))
+fwd(params, tokens).block_until_ready()
+t0 = time.perf_counter()
+reps = 3
+for _ in range(reps):
     fwd(params, tokens).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(3):
-        fwd(params, tokens).block_until_ready()
-    out["llama3_1b_fwd_tokens_per_s"] = round(2048 / ((time.perf_counter() - t0) / 3), 1)
+dt = (time.perf_counter() - t0) / reps
+tok_s = seq / dt
+out["llama3_1b_fwd_tokens_per_s"] = round(tok_s, 1)
+out["llama3_1b_params"] = n_params
+# Forward-only FLOPs ~ 2 * params * tokens (matmul-dominated).
+out["llama3_1b_fwd_TFLOPs"] = round(2 * n_params * tok_s / 1e12, 2)
 print("TPUBENCH " + json.dumps(out))
 """
 
 
-def bench_tpu_details(timeout_s=600):
-    """TPU-side sub-benches (staging bandwidth + model forward), run in
-    a subprocess so an unreachable device tunnel times out instead of
-    hanging the whole bench."""
-    import subprocess
+def bench_tpu_details(probe_timeout_s=120, bench_timeout_s=600):
+    """TPU sub-benches with reachability RECORDED. The tunnel in this
+    environment can hang for minutes; probe cheaply (with one retry)
+    before attempting the expensive compile-and-run, and put the
+    failure mode in the output instead of returning {}."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+
+    def probe():
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _TPU_PROBE], capture_output=True,
+                text=True, timeout=probe_timeout_s, env=env)
+            for line in proc.stdout.splitlines():
+                if line.startswith("TPUPROBE "):
+                    return json.loads(line[len("TPUPROBE "):]), None
+            return None, (proc.stderr or "no probe output").strip()[-300:]
+        except subprocess.TimeoutExpired:
+            return None, f"probe timed out after {probe_timeout_s}s"
+        except Exception as e:  # noqa: BLE001
+            return None, f"{type(e).__name__}: {e}"
+
+    devs, err = probe()
+    if devs is None:
+        devs, err2 = probe()  # the tunnel is flaky; one retry
+        if devs is None:
+            return {"tpu": f"unreachable: {err} / retry: {err2}"}
+    accel = [d for d in devs if d["platform"] != "cpu"]
+    if not accel:
+        return {"tpu": f"no accelerator devices (saw {devs})"}
 
     try:
         proc = subprocess.run(
-            [sys.executable, "-c",
-             _TPU_SNIPPET % os.path.dirname(os.path.abspath(__file__))],
-            capture_output=True, text=True, timeout=timeout_s)
+            [sys.executable, "-c", _TPU_SNIPPET % REPO],
+            capture_output=True, text=True, timeout=bench_timeout_s,
+            env=env)
         for line in proc.stdout.splitlines():
             if line.startswith("TPUBENCH "):
-                return json.loads(line[len("TPUBENCH "):])
-    except Exception:
-        pass
-    return {}
+                out = json.loads(line[len("TPUBENCH "):])
+                out["tpu"] = "reachable"
+                kind = out.get("device_kind", "?")
+                peak = None
+                for key, tf in _CHIP_PEAK_TFLOPS.items():
+                    if key in str(kind).lower().replace(" ", ""):
+                        peak = tf
+                env_peak = os.environ.get("TDR_TPU_PEAK_TFLOPS")
+                if env_peak:
+                    peak = float(env_peak)
+                if peak and "llama3_1b_fwd_TFLOPs" in out:
+                    out["chip_peak_bf16_TFLOPs"] = peak
+                    out["llama3_1b_fwd_MFU"] = round(
+                        out["llama3_1b_fwd_TFLOPs"] / peak, 4)
+                return out
+        return {"tpu": "bench failed: " +
+                (proc.stderr or "no output").strip()[-300:]}
+    except subprocess.TimeoutExpired:
+        return {"tpu": f"bench timed out after {bench_timeout_s}s "
+                       "(probe was reachable)"}
+    except Exception as e:  # noqa: BLE001
+        return {"tpu": f"bench error: {type(e).__name__}: {e}"}
 
 
 def main():
     details = {}
+    from rocnrdma_tpu.transport.engine import copy_pool_workers
+
+    details["copy_pool_workers"] = copy_pool_workers()
+    memcpy, fold = bench_roofline()
+    details["roofline_memcpy_GBps"] = memcpy
+    details["roofline_fold_GBps"] = fold
     details["p2p_write_GBps"] = round(bench_p2p_write(), 3)
     bus = bench_allreduce()
     details["allreduce_world"] = 2
     details["allreduce_bytes"] = 1 << 30
-    details.update(bench_tpu_details())
+    details["sweep_write"] = bench_sweep()
+    if os.environ.get("TDR_BENCH_NO_TPU", "0") in ("", "0"):
+        details.update(bench_tpu_details())
+    else:
+        details["tpu"] = "skipped (TDR_BENCH_NO_TPU)"
     print(json.dumps({
         "metric": "cross_slice_allreduce_bus_bw",
         "value": round(bus, 3),
         "unit": "GB/s",
         "vs_baseline": round(bus / TARGET_BUS_GBPS, 3),
+        # Fraction of the single-core fold roofline — what this host
+        # physically allows for a fold-bound allreduce (see module
+        # docstring). >1 is possible on multi-core hosts.
+        "vs_roofline": round(bus / fold, 3) if fold else None,
         "details": details,
     }))
 
