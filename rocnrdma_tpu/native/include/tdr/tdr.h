@@ -103,6 +103,11 @@ uint64_t tdr_mr_len(const tdr_mr *mr);
  * free_callback_called handshake of amdp2p.c:299-302). */
 int tdr_mr_invalidate(tdr_mr *mr);
 
+/* Whether the CPU can fold into this MR's memory (false for verbs
+ * dma-buf MRs — no CPU mapping). Ring allreduces over non-foldable
+ * MRs fail up front with a clear error. */
+int tdr_mr_cpu_foldable(const tdr_mr *mr);
+
 /* Connection bring-up over an out-of-band TCP rendezvous (the role
  * perftest's TCP port plays). Blocking; one QP per call. */
 tdr_qp *tdr_listen(tdr_engine *e, const char *bind_host, int port);
@@ -149,6 +154,11 @@ int tdr_qp_has_send_foldback(tdr_qp *qp);
  * rightward schedules instead of a per-rank wire mismatch). */
 int tdr_qp_has_fused2(tdr_qp *qp);
 
+/* Max recv_reduce postings this QP wants in flight (bounded staging
+ * engines — verbs — return their slot budget; 0 = unbounded). The
+ * ring layer sizes its recv window to this. */
+size_t tdr_qp_rr_window(tdr_qp *qp);
+
 /* Poll up to `max` completions; waits up to timeout_ms (0 = non-block,
  * -1 = forever). Returns count, or -1 on error. */
 int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms);
@@ -194,6 +204,18 @@ int tdr_ring_unregister(tdr_ring *r, void *base);
  * against pinned device memory, no host staging. */
 int tdr_ring_adopt_mr(tdr_ring *r, void *base, tdr_mr *mr);
 void tdr_ring_destroy(tdr_ring *r);
+
+/* Which schedule the LAST tdr_ring_allreduce on this ring ran —
+ * introspection for tests/benches asserting that the negotiated
+ * capabilities actually selected the fused paths. */
+enum {
+  TDR_SCHED_NONE = 0,     /* no allreduce yet */
+  TDR_SCHED_GENERIC = 1,  /* two-phase pipeline (scratch fold) */
+  TDR_SCHED_FUSED2 = 2,   /* world-2 fused exchange */
+  TDR_SCHED_FUSED2_FB = 3,/* world-2 fused exchange with foldback */
+  TDR_SCHED_WAVEFRONT = 4,/* world>2 flattened wavefront */
+};
+int tdr_ring_last_schedule(const tdr_ring *r);
 
 #ifdef __cplusplus
 }

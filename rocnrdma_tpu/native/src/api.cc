@@ -80,6 +80,10 @@ int tdr_mr_invalidate(tdr_mr *mr) {
   return reinterpret_cast<Mr *>(mr)->invalidate();
 }
 
+int tdr_mr_cpu_foldable(const tdr_mr *mr) {
+  return reinterpret_cast<const Mr *>(mr)->cpu_foldable() ? 1 : 0;
+}
+
 tdr_qp *tdr_listen(tdr_engine *e, const char *bind_host, int port) {
   return reinterpret_cast<tdr_qp *>(
       reinterpret_cast<Engine *>(e)->listen(bind_host, port));
@@ -143,6 +147,10 @@ int tdr_qp_has_send_foldback(tdr_qp *qp) {
 
 int tdr_qp_has_fused2(tdr_qp *qp) {
   return reinterpret_cast<Qp *>(qp)->has_fused2() ? 1 : 0;
+}
+
+size_t tdr_qp_rr_window(tdr_qp *qp) {
+  return reinterpret_cast<Qp *>(qp)->rr_window_hint();
 }
 
 int tdr_poll(tdr_qp *qp, tdr_wc *wc, int max, int timeout_ms) {

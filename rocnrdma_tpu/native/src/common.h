@@ -29,6 +29,13 @@ class Mr {
   uint32_t rkey = 0;
   int access = 0;
   std::atomic<bool> valid{true};
+  // Whether the CPU can fold into this MR's memory (reduce-on-receive
+  // and the scratch-fold schedules need it). False only for verbs
+  // dma-buf MRs, which have no CPU mapping — an allreduce over such a
+  // buffer needs switch offload (SHARP-class) or a host bounce, and
+  // the ring fails it up front with a clear error instead of
+  // scribbling through a device IOVA as if it were a pointer.
+  virtual bool cpu_foldable() const { return true; }
   // Revoke: remote access must start failing immediately.
   virtual int invalidate() = 0;
 };
@@ -66,6 +73,12 @@ class Qp {
   // (wire-incompatible with the rightward-only schedules); both ends
   // must advertise it in the handshake before a ring may enter it.
   virtual bool has_fused2() const { return false; }
+  // Engines whose reduce-on-receive stages through bounded slots (the
+  // verbs backend: an HCA has no fold ALU) advertise how many
+  // recv_reduce postings may be in flight; 0 = unbounded (emu folds
+  // straight off the wire). The ring layer sizes its recv window to
+  // this so staging memory stays at window * chunk bytes.
+  virtual size_t rr_window_hint() const { return 0; }
   virtual int poll(tdr_wc *wc, int max, int timeout_ms) = 0;
   virtual int close_qp() = 0;
 };
@@ -85,6 +98,30 @@ class Engine {
 
 Engine *create_emu_engine(std::string *err);
 Engine *create_verbs_engine(const std::string &device, std::string *err);
+
+// Feature bits advertised during connection bring-up — shared by BOTH
+// backends so a verbs QP negotiates the fused capabilities exactly the
+// way the emu Hello does. Wire-protocol- or schedule-changing
+// capabilities MUST be negotiated (mine & theirs), never assumed from
+// local state: a per-rank env override that silently changed the
+// frames/schedule one side runs would wedge the other.
+enum : uint32_t {
+  FEAT_FOLDBACK = 1u << 0,
+  // Participation in the world-2 fused exchange schedule (FusedTwo).
+  // Schedule-changing rather than frame-changing: a rank running
+  // FusedTwo sends phase-2 reduced-B chunks on its LEFT QP while the
+  // rightward-only schedules send everything rightward.
+  FEAT_FUSED2 = 1u << 1,
+};
+
+// Locally-willing feature set (TDR_NO_FOLDBACK / TDR_NO_FUSED2 act
+// here, at the advertising stage, so an opted-out rank degrades the
+// WHOLE connection instead of emitting mismatched wire traffic).
+uint32_t local_features();
+
+// True when an env flag is set and not "0" — the one truthiness rule
+// for all TDR_* opt-out knobs.
+bool env_set(const char *name);
 
 // Element size for a TDR_DT_*; 0 for unknown.
 size_t dtype_size(int dt);

@@ -82,20 +82,8 @@ struct FrameHdr {
 #pragma pack(pop)
 static_assert(sizeof(FrameHdr) == 40, "wire format");
 
-// Feature bits advertised in the handshake. Wire-protocol-changing
-// capabilities MUST be negotiated (mine & theirs), never assumed from
-// local state: a per-rank env override that silently changed the
-// frames one side emits would wedge the other (see FEAT_FOLDBACK —
-// its frames are only valid against a peer that folds them).
-enum : uint32_t {
-  FEAT_FOLDBACK = 1u << 0,
-  // Participation in the world-2 fused exchange schedule (FusedTwo).
-  // Not a frame format by itself, but schedule-changing: a rank running
-  // FusedTwo sends phase-2 reduced-B chunks on its LEFT QP while the
-  // generic/wavefront schedules send everything rightward — the streams
-  // are wire-incompatible, so entry must be agreed by both ends.
-  FEAT_FUSED2 = 1u << 1,
-};
+// Feature bits (FEAT_FOLDBACK / FEAT_FUSED2) and the local_features()
+// advertising helper are shared with the verbs backend — see common.h.
 
 // Connection handshake: each side announces identity and a probe
 // address; each side then attempts a cross-memory read of the peer's
@@ -155,24 +143,7 @@ std::string read_boot_id() {
   return std::string(buf);
 }
 
-bool env_set(const char *name) {
-  const char *env = getenv(name);
-  return env && *env && *env != '0';
-}
-
 bool cma_disabled() { return env_set("TDR_NO_CMA"); }
-
-// Locally-willing feature set. The env opt-outs act here, at the
-// advertising stage, so a rank with TDR_NO_FOLDBACK set degrades the
-// WHOLE connection to the compatible schedule instead of silently
-// emitting a different wire protocol than its peer expects.
-uint32_t local_features() {
-  uint32_t f = 0;
-  if (!env_set("TDR_NO_FOLDBACK") && !env_set("TDR_NO_FUSED2"))
-    f |= FEAT_FOLDBACK;
-  if (!env_set("TDR_NO_FUSED2")) f |= FEAT_FUSED2;
-  return f;
-}
 
 // Payload-size sanity cap for wire-controlled allocations (bounced
 // unexpected messages, foldback buffers): a corrupt peer must not be

@@ -34,6 +34,14 @@ constexpr int kWindow = 4;  // pre-posted recv slots per step
 // segments (the emu backend's unbounded queues would hide that).
 constexpr size_t kMaxOutstanding = 256;
 
+// Recv-window bound for reduce-recvs on a QP: engines that stage
+// reduce-on-receive through bounded slots (verbs) advertise a budget
+// via tdr_qp_rr_window; 0 means unbounded (emu folds off the wire).
+size_t reduce_recv_window(tdr_qp *qp) {
+  size_t w = tdr_qp_rr_window(qp);
+  return w ? std::min(w, kMaxOutstanding) : kMaxOutstanding;
+}
+
 size_t ring_chunk_bytes() {
   const char *env = getenv("TDR_RING_CHUNK");
   if (env && *env) {
@@ -74,6 +82,7 @@ struct tdr_ring {
   int rank;
   int world;
   size_t chunk = kDefaultChunk;
+  int last_sched = TDR_SCHED_NONE;
   std::vector<char> tmp;
   tdr_mr *tmp_mr = nullptr;
   // MRs for buffers the CALLER promised stable (tdr_ring_register) —
@@ -170,6 +179,10 @@ int tdr_ring_register(tdr_ring *r, void *base, size_t len) {
   if (!mr) return -1;
   r->registered[key] = mr;
   return 0;
+}
+
+int tdr_ring_last_schedule(const tdr_ring *r) {
+  return r ? r->last_sched : TDR_SCHED_NONE;
 }
 
 int tdr_ring_unregister(tdr_ring *r, void *base) {
@@ -273,8 +286,10 @@ struct StepPipe {
     // inbound chunks always have a landing target; windowed phase-1
     // receives pre-post up to the scratch window. Both bounded by the
     // QP depth — drain() reposts as completions retire.
-    size_t prepost = windowed ? std::min(n_recv, slots)
-                              : std::min(n_recv, kMaxOutstanding);
+    size_t prepost = windowed
+                         ? std::min(n_recv, slots)
+                         : std::min(n_recv, fused ? reduce_recv_window(r->left)
+                                                  : kMaxOutstanding);
     for (; posted_r < prepost; posted_r++)
       if (post_recv_chunk(posted_r) != 0) return -1;
 
@@ -450,9 +465,11 @@ struct FusedTwo {
   int run() {
     // Pre-post the inbound streams deep: every target is a disjoint
     // slice of the data MR (folds for B, final placement for A), so
-    // only the QP depth bounds the window. In foldback mode there is
-    // no A-final stream — the send ack carries that meaning.
-    for (; posted_rB < std::min(n_b, kMaxOutstanding); posted_rB++)
+    // the QP depth — and, for staged-fold engines, the reduce-recv
+    // slot budget — bounds the window. In foldback mode there is no
+    // A-final stream — the send ack carries that meaning.
+    const size_t rb_win = reduce_recv_window(r->left);
+    for (; posted_rB < std::min(n_b, rb_win); posted_rB++)
       if (post_recv_b(posted_rB) != 0) return -1;
     if (!use_fb)
       for (; posted_rA < std::min(n_a, kMaxOutstanding); posted_rA++)
@@ -518,10 +535,7 @@ struct FusedTwo {
   }
 };
 
-bool wavefront_disabled() {
-  const char *env = getenv("TDR_NO_WAVEFRONT");
-  return env && *env && *env != '0';
-}
+bool wavefront_disabled() { return tdr::env_set("TDR_NO_WAVEFRONT"); }
 
 // ------------------------------------------------------------------
 // Wavefront ring (world > 2, reduce-on-receive engines): the classic
@@ -591,10 +605,14 @@ struct Wavefront {
 
   int run() {
     const size_t N = sends.size(), M = recvs.size();
+    // Mixed reduce/place recv stream: bound the whole window by the
+    // engine's reduce-recv budget (conservative for place-only spans,
+    // but the window refills as completions retire).
+    const size_t r_win = reduce_recv_window(r->left);
     while (acked_s < N || done_r < M) {
       bool progressed = false;
       // Keep the recv window deep (disjoint targets; FIFO-matched).
-      while (posted_r < M && posted_r - done_r < kMaxOutstanding) {
+      while (posted_r < M && posted_r - done_r < r_win) {
         if (post_recv_item(posted_r) != 0) return -1;
         posted_r++;
         progressed = true;
@@ -661,6 +679,20 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
   bool owned = false;
   tdr_mr *dmr = r->data_mr(data, nbytes, &owned);
   if (!dmr) return -1;
+  if (!tdr_mr_cpu_foldable(dmr)) {
+    // EVERY schedule folds host-side somewhere (recv_reduce slots or
+    // the scratch window into the data pointer) — over a CPU-less
+    // dma-buf MR that would scribble through a device IOVA. Fail
+    // clearly up front; such buffers need switch offload or a
+    // host-visible mapping (the emu backend mmaps its dma-bufs, so
+    // only real-HCA device memory lands here).
+    if (owned) tdr_dereg_mr(dmr);
+    tdr::set_error(
+        "ring_allreduce: data MR has no CPU mapping (verbs dma-buf); "
+        "host-side reduction is impossible — register CPU-visible "
+        "memory or use a host-staged collective");
+    return -1;
+  }
   struct OwnedGuard {
     tdr_mr *mr;
     bool active;
@@ -695,6 +727,7 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
     // the QP handshake, where TDR_NO_FOLDBACK/TDR_NO_FUSED2 act), so
     // both ranks take the same branch here by construction.
     f.use_fb = tdr_qp_has_send_foldback(r->right);
+    r->last_sched = f.use_fb ? TDR_SCHED_FUSED2_FB : TDR_SCHED_FUSED2;
     return f.run();
   }
 
@@ -746,9 +779,11 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
         wf.recvs.push_back({seg_off[rs] + c * chunk, clen(seg_len[rs], c),
                             fold, 0});
     }
+    r->last_sched = TDR_SCHED_WAVEFRONT;
     return wf.run();
   }
 
+  r->last_sched = TDR_SCHED_GENERIC;
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};
 
   // Phase 1: reduce-scatter. After step s, segment (rank-s-1) holds the

@@ -9,6 +9,7 @@
 #include <unistd.h>
 
 #include <chrono>
+#include <cstdlib>
 #include <thread>
 
 #include "common.h"
@@ -19,6 +20,19 @@ static thread_local std::string g_error;
 
 void set_error(const std::string &msg) { g_error = msg; }
 const char *get_error() { return g_error.c_str(); }
+
+bool env_set(const char *name) {
+  const char *env = getenv(name);
+  return env && *env && *env != '0';
+}
+
+uint32_t local_features() {
+  uint32_t f = 0;
+  if (!env_set("TDR_NO_FOLDBACK") && !env_set("TDR_NO_FUSED2"))
+    f |= FEAT_FOLDBACK;
+  if (!env_set("TDR_NO_FUSED2")) f |= FEAT_FUSED2;
+  return f;
+}
 
 size_t dtype_size(int dt) {
   switch (dt) {

@@ -76,6 +76,8 @@ enum ibv_send_flags {
 
 enum ibv_wc_status { IBV_WC_SUCCESS = 0 };
 
+enum ibv_wc_flags { IBV_WC_GRH = 1 << 0, IBV_WC_WITH_IMM = 1 << 1 };
+
 enum ibv_wc_opcode {
   IBV_WC_SEND = 0,
   IBV_WC_RDMA_WRITE = 1,

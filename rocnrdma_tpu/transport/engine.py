@@ -47,6 +47,10 @@ OP_WRITE, OP_READ, OP_SEND, OP_RECV = 0, 1, 2, 3
 DT_F32, DT_F64, DT_I32, DT_I64, DT_BF16 = 0, 1, 2, 3, 4
 RED_SUM, RED_MAX, RED_MIN = 0, 1, 2
 
+# Ring schedules (tdr_ring_last_schedule)
+SCHED_NONE, SCHED_GENERIC, SCHED_FUSED2, SCHED_FUSED2_FB, SCHED_WAVEFRONT = \
+    0, 1, 2, 3, 4
+
 _NUMPY_DTYPE_MAP = {
     "float32": DT_F32,
     "float64": DT_F64,
@@ -151,6 +155,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_adopt_mr.argtypes = [P, P, P]
     lib.tdr_qp_has_fused2.restype = ctypes.c_int
     lib.tdr_qp_has_fused2.argtypes = [P]
+    lib.tdr_qp_rr_window.restype = ctypes.c_size_t
+    lib.tdr_qp_rr_window.argtypes = [P]
+    lib.tdr_ring_last_schedule.restype = ctypes.c_int
+    lib.tdr_ring_last_schedule.argtypes = [P]
     lib.tdr_ring_allreduce.restype = ctypes.c_int
     lib.tdr_ring_allreduce.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
@@ -411,6 +419,14 @@ class Ring:
         rc = _load().tdr_ring_allreduce(_live(self._h, "ring_allreduce"),
                                         ptr, array.size, dt, op)
         _check(rc == 0, "ring_allreduce")
+
+    @property
+    def last_schedule(self) -> int:
+        """Which SCHED_* the last allreduce on this ring ran — lets
+        tests assert that negotiated capabilities actually selected
+        the fused paths (not just that results are correct)."""
+        return int(_load().tdr_ring_last_schedule(
+            _live(self._h, "last_schedule")))
 
     def destroy(self) -> None:
         if self._h:

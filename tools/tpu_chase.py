@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Persistent TPU-tunnel chaser (VERDICT r03 task 3).
+
+The device tunnel in this environment is flaky: it answered probes in
+some rounds and hung for whole rounds in others. This script makes the
+attempts third-party-verifiable: it retries the TPU sub-benches on an
+interval, appends one JSON line per attempt (timestamp, outcome, error)
+to TPU_ATTEMPTS_r04.jsonl, and writes the full results to
+TPU_RESULTS_r04.json the first time the tunnel answers. bench.py folds
+the banked results into its output (labeled with their capture time)
+when a live probe fails at bench time.
+
+Each attempt runs the probe in a SUBPROCESS with a hard timeout —
+a hung jax.devices() can only burn its own interpreter.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ATTEMPTS = os.path.join(REPO, "TPU_ATTEMPTS_r04.jsonl")
+RESULTS = os.path.join(REPO, "TPU_RESULTS_r04.json")
+
+BENCH = r"""
+import json, time, sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax, jax.numpy as jnp
+
+out = {"ts": time.strftime("%%Y-%%m-%%dT%%H:%%M:%%SZ", time.gmtime())}
+devs = [d for d in jax.devices() if d.platform != "cpu"]
+dev = devs[0]
+out["device_kind"] = getattr(dev, "device_kind", "?")
+print("STEP devices", flush=True)
+
+x = jax.device_put(np.ones(1024, np.float32), dev)
+intro = {}
+try:
+    intro["unsafe_buffer_pointer"] = hex(x.unsafe_buffer_pointer())
+except Exception as e:
+    intro["unsafe_buffer_pointer"] = f"unavailable: {e}"
+try:
+    intro["__dlpack__"] = str(type(x.__dlpack__()))
+except Exception as e:
+    intro["__dlpack__"] = f"unavailable: {e}"
+out["hbm_introspection"] = intro
+print("STEP intro", flush=True)
+
+for mb in (16, 64):
+    n = mb * (1 << 20) // 4
+    host = np.ones(n, dtype=np.float32)
+    t0 = time.perf_counter()
+    darr = jax.device_put(host, dev); darr.block_until_ready()
+    out[f"tpu_h2d_GBps_{mb}MB"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
+    t0 = time.perf_counter()
+    _ = np.asarray(darr)
+    out[f"tpu_d2h_GBps_{mb}MB"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
+    print(f"STEP h2d_{mb}", flush=True)
+
+for k in (4096, 8192):
+    a = jnp.ones((k, k), jnp.bfloat16); b = jnp.ones((k, k), jnp.bfloat16)
+    mm = jax.jit(lambda a, b: a @ b)
+    mm(a, b).block_until_ready()
+    t0 = time.perf_counter(); reps = 5
+    for _ in range(reps):
+        r = mm(a, b)
+    r.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    out[f"matmul_bf16_{k}_TFLOPs"] = round(2 * k**3 / dt / 1e12, 2)
+    print(f"STEP matmul_{k}", flush=True)
+
+from rocnrdma_tpu.models.llama import make_model, init_params
+model = make_model("llama3-1b")
+params = init_params(model, jax.random.PRNGKey(0))
+params = jax.device_put(params, dev)
+seq = 2048
+tokens = jnp.ones((1, seq), dtype=jnp.int32)
+fwd = jax.jit(lambda p, t: model.apply(p, t))
+fwd(params, tokens).block_until_ready()
+t0 = time.perf_counter()
+reps = 3
+for _ in range(reps):
+    r = fwd(params, tokens)
+r.block_until_ready()
+dt = (time.perf_counter() - t0) / reps
+n_params = model.cfg.param_count()
+out["llama3_1b_fwd_tokens_per_s"] = round(seq / dt, 1)
+out["llama3_1b_params"] = n_params
+out["llama3_1b_fwd_TFLOPs"] = round(2 * n_params * (seq / dt) / 1e12, 2)
+print("STEP llama", flush=True)
+
+# Pallas-vs-XLA forward timing (the kernels default off; measure both).
+try:
+    import os as _os
+    from rocnrdma_tpu.models.llama import make_model as mk
+    mp = mk("llama3-1b", use_pallas_attention=True, use_pallas_rmsnorm=True)
+    fwd_p = jax.jit(lambda p, t: mp.apply(p, t))
+    fwd_p(params, tokens).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = fwd_p(params, tokens)
+    r.block_until_ready()
+    dtp = (time.perf_counter() - t0) / reps
+    out["llama3_1b_fwd_tokens_per_s_pallas"] = round(seq / dtp, 1)
+except Exception as e:
+    out["pallas_fwd"] = f"failed: {type(e).__name__}: {e}"
+print("TPUBENCH " + json.dumps(out), flush=True)
+"""
+
+
+def attempt(timeout_s):
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    t0 = time.time()
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", BENCH % {"repo": REPO}],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        steps = [l for l in proc.stdout.splitlines() if l.startswith("STEP")]
+        rec["steps"] = len(steps)
+        for line in proc.stdout.splitlines():
+            if line.startswith("TPUBENCH "):
+                rec["ok"] = True
+                return rec, json.loads(line[len("TPUBENCH "):])
+        rec["ok"] = False
+        rec["error"] = ("no TPUBENCH line; last stderr: " +
+                        (proc.stderr or "").strip()[-200:])
+    except subprocess.TimeoutExpired as e:
+        rec["ok"] = False
+        partial = (e.stdout or b"")
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        steps = [l for l in partial.splitlines() if l.startswith("STEP")]
+        rec["steps"] = len(steps)
+        rec["error"] = f"timeout after {timeout_s}s (progressed {len(steps)} steps)"
+    except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+        rec["ok"] = False
+        rec["error"] = f"{type(e).__name__}: {e}"
+    rec["wall_s"] = round(time.time() - t0, 1)
+    return rec, None
+
+
+def main():
+    interval = int(os.environ.get("TDR_CHASE_INTERVAL_S", "600"))
+    timeout_s = int(os.environ.get("TDR_CHASE_TIMEOUT_S", "900"))
+    once = "--once" in sys.argv
+    while True:
+        rec, results = attempt(timeout_s)
+        with open(ATTEMPTS, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        if results is not None:
+            with open(RESULTS, "w") as f:
+                json.dump(results, f, indent=1)
+            print("banked:", RESULTS)
+            return 0
+        print("attempt failed:", rec.get("error"), flush=True)
+        if once:
+            return 1
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
