@@ -62,7 +62,8 @@ from rocnrdma_tpu.collectives.staging import staging
 from rocnrdma_tpu.collectives.world import RingWorld
 from rocnrdma_tpu.hbm.registry import (HbmError, MemoryExporter,
                                        RegistrationManager, as_ndarray)
-from rocnrdma_tpu.transport.engine import RED_SUM, _NUMPY_DTYPE_MAP
+from rocnrdma_tpu.transport.engine import (ENGINE_VERBS, RED_SUM,
+                                           _NUMPY_DTYPE_MAP)
 from rocnrdma_tpu.utils.trace import trace
 
 # Bound on cached zero-copy registrations. XLA's allocator reuses
@@ -346,28 +347,70 @@ class CrossSliceAllReduce:
                  f"mean={int(self.mean)}"]
         sched += [f"z:{nbytes}:{arr.dtype}" for _, nbytes, arr in coalesced]
         sched += [f"j:{nbytes}:{buf.dtype}" for _, nbytes, buf in jax_ops]
-        sched += [f"s:{d}:{sum(int(leaves[i].size) for i in idxs)}"
-                  for d, idxs in groups.items()]
+        # Per-leaf sizes (not just the sum): ranks with different
+        # per-leaf splits that total the same would otherwise pass the
+        # check yet scatter different slices back.
+        sched += [
+            "s:{}:{}".format(d, ",".join(str(int(leaves[i].size))
+                                         for i in idxs))
+            for d, idxs in groups.items()]
         describe = " ".join(sched)
-        check = getattr(self.world, "check_schedule", None)
-        if check is not None:
-            check(hashlib.sha256(describe.encode()).digest(), describe)
-
-        for va, nbytes, arr in coalesced:
-            self._zero_copy(arr, va, nbytes)
-            used_keys.add((va, nbytes))
         unhold = getattr(self.exporter, "unhold", None)
-        for va, nbytes, buf in jax_ops:
-            # Flat elementwise view over the shard's XLA buffer — the
-            # reduction happens directly in device memory.
-            view = as_ndarray(
-                va, (nbytes // np.dtype(buf.dtype).itemsize,), buf.dtype)
-            self._zero_copy(view, va, nbytes)
-            used_keys.add((va, nbytes))
+        # reg_mr on a pinning engine (verbs) pins PHYSICAL pages: if
+        # the allocator unmaps a freed buffer (glibc munmaps large
+        # blocks) and a recycled VA maps new pages, a warm-cached MR
+        # would DMA into the old, stale pages. The warm-cache contract
+        # is emu-only; pinning engines tear the registration down
+        # every step instead (correct, pays re-registration).
+        pinning = self.world.engine.kind == ENGINE_VERBS
+        try:
+            check = getattr(self.world, "check_schedule", None)
+            if check is not None:
+                check(hashlib.sha256(describe.encode()).digest(), describe)
+
+            for va, nbytes, arr in coalesced:
+                self._zero_copy(arr, va, nbytes)
+                used_keys.add((va, nbytes))
+            for va, nbytes, buf in jax_ops:
+                # Flat elementwise view over the shard's XLA buffer —
+                # the reduction happens directly in device memory.
+                view = as_ndarray(
+                    va, (nbytes // np.dtype(buf.dtype).itemsize,),
+                    buf.dtype)
+                self._zero_copy(view, va, nbytes)
+                if pinning:
+                    self._drop_cached((va, nbytes))
+                else:
+                    used_keys.add((va, nbytes))
+                    if unhold is not None:
+                        # Steady state: let XLA reuse the buffer next
+                        # step so the registration cache converges
+                        # (see TPUExporter).
+                        unhold(va)
+        except BaseException:
+            # A failed schedule check (or a mid-loop transport error)
+            # must not leak the adopted buffer refs — a caller that
+            # catches and retries would otherwise accumulate held XLA
+            # buffers every failed step.
             if unhold is not None:
-                # Steady state: let XLA reuse the buffer next step so
-                # the registration cache converges (see TPUExporter).
-                unhold(va)
+                for va, _, _ in jax_ops:
+                    try:
+                        unhold(va)
+                    except Exception:
+                        pass
+            if pinning:
+                # And on a pinning engine it must not leave a warm
+                # registration either: after the unhold XLA may remap
+                # the VA onto new pages while the cached MR still pins
+                # the old ones — the stale-page DMA hazard this branch
+                # exists to eliminate.
+                for va, nbytes, _ in jax_ops:
+                    if (va, nbytes) in self._regs:
+                        try:
+                            self._drop_cached((va, nbytes))
+                        except Exception:
+                            pass
+            raise
 
         # Staged fallback for everything else, packed per dtype.
         for dtype_str, idxs in groups.items():

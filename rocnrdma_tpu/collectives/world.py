@@ -74,6 +74,9 @@ class RingWorld:
         # Schedule-digest buffers (check_schedule), registered lazily.
         self._dg_send = self._dg_recv = None
         self._dg_smr = self._dg_rmr = None
+        # Last ring-verified schedule digest: steady-state calls with
+        # an unchanged digest skip the exchange entirely.
+        self._sched_verified: bytes = b""
         trace.event("world.up", rank=rank, world=world)
 
     def allreduce(self, array, op: int = RED_SUM) -> None:
@@ -110,20 +113,47 @@ class RingWorld:
         divergence can never desynchronize the QP message stream
         (a skipped exchange would let the neighbor's digest frame be
         consumed by a gradient recv as data).
+
+        **Steady-state amortization**: once a digest has gone through
+        the full exchange, later calls with the SAME digest skip it —
+        they post only ring work requests. This is deterministic
+        across ranks: a successful exchange of digest D means every
+        rank verified D, so every rank's cache holds D and every rank
+        skips the same calls (env divergence included — the first
+        call exchanges on every rank regardless of
+        TDR_NO_SCHED_CHECK). A rank whose schedule CHANGES re-runs
+        the exchange; if all ranks changed identically it verifies
+        and re-caches, and if they diverged it fails fast here. The
+        residual (unchecked) case is a schedule change on a strict
+        subset of ranks against a previously-verified steady state —
+        that desynchronizes the ring and surfaces as a completion
+        error or the ring stall deadline, never silent corruption of
+        a fold (the 30 s failure mode the first-call check exists to
+        beat; steady-state steps buy zero per-step hops for it).
         """
+        if digest == self._sched_verified:
+            trace.event("world.sched_cached")
+            return
         if self._dg_smr is None:
-            self._dg_send = np.zeros(32, dtype=np.uint8)
-            self._dg_recv = np.zeros(32, dtype=np.uint8)
+            # 33 bytes, deliberately indivisible by every ring dtype
+            # size: if steady-state skew ever mismatches a digest frame
+            # against a posted reduce-recv (a subset-of-ranks schedule
+            # change), the fold VALIDATION rejects it — the frame can
+            # error a step but can never be silently summed into a
+            # live gradient buffer.
+            self._dg_send = np.zeros(33, dtype=np.uint8)
+            self._dg_recv = np.zeros(33, dtype=np.uint8)
             self._dg_smr = self.engine.reg_mr(self._dg_send)
             self._dg_rmr = self.engine.reg_mr(self._dg_recv)
         assert len(digest) == 32
         timeout = int(os.environ.get("TDR_RING_TIMEOUT_MS", "30000"))
         check = os.environ.get("TDR_NO_SCHED_CHECK", "0") in ("", "0")
 
+        trace.event("world.sched_check")
         self._dg_recv[:] = 0
-        self._dg_send[:] = np.frombuffer(digest, dtype=np.uint8)
-        self._dg_hop(32, timeout, "digest")
-        got = self._dg_recv.tobytes()
+        self._dg_send[:32] = np.frombuffer(digest, dtype=np.uint8)
+        self._dg_hop(33, timeout, "digest")
+        got = self._dg_recv[:32].tobytes()
         ok = got == digest
 
         status = 1 if (ok or not check) else 0
@@ -131,6 +161,10 @@ class RingWorld:
             self._dg_send[0] = status
             self._dg_hop(1, timeout, "status")
             status = min(status, int(self._dg_recv[0]))
+        if status == 1:
+            # Ring-wide agreement on this digest (or on skipping the
+            # comparison): steady-state repeats can skip the exchange.
+            self._sched_verified = digest
         if not check:
             return
         if not ok:

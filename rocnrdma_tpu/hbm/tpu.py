@@ -137,10 +137,31 @@ class TPUExporter(MemoryExporter):
         """Adopt (or refresh) an explicit VA range — the per-shard form
         ``shard_regions`` feeds. ``owner`` (the shard buffer) is held
         so XLA cannot free it while the range is being registered;
-        ``unhold`` drops the ref once steady state is reached."""
+        ``unhold`` drops the ref once steady state is reached.
+
+        Adoptions from DEAD layouts are pruned here: a stale entry
+        (different base) overlapping the new range describes memory
+        the allocator has since handed to THIS buffer, so it can never
+        be acted on again — and, left around, a smaller stale range
+        can shadow the new one in the containment lookup (the cause of
+        sporadic "is not exporter memory" failures under allocator
+        churn). Stale entries with live pins are kept: their cached
+        registration still covers these arena pages, and the range
+        lookup is full-cover so they cannot shadow."""
         with self._lock:
-            prev = self._adopted.get(va)
-            self._adopted[va] = (owner, max(nbytes, prev[1] if prev else 0))
+            # EXACT size, never grown from a stale previous adoption:
+            # a kept-around larger size describes a dead layout, and
+            # both overlap pruning and containment matching must see
+            # the CURRENT buffer's true extent only.
+            end = va + nbytes
+            for base in [
+                    b for b, (_, bn) in self._adopted.items()
+                    if b != va and b < end and va < b + bn]:
+                if not any(base <= p.va < base + self._adopted[base][1]
+                           and not p._released
+                           for (p, _, _) in self._pins.values()):
+                    del self._adopted[base]
+            self._adopted[va] = (owner, nbytes)
         trace.event("tpu.adopt_region", va=va, bytes=nbytes)
 
     def unhold(self, va: int) -> None:
@@ -156,7 +177,13 @@ class TPUExporter(MemoryExporter):
         invariant (SURVEY.md §3.3) for arrays that are re-materialized
         every step. The registered range stays mapped (CPU allocators
         recycle, they don't unmap arena pages); the collective only
-        ever touches it through a live leaf that currently occupies it."""
+        ever touches it through a live leaf that currently occupies it.
+
+        NON-PINNING ENGINES ONLY (emu): on a pinning engine (verbs
+        reg_mr) the cached MR pins physical pages, and a freed-then-
+        remapped VA would leave the MR DMAing into stale pages — the
+        collective layer tears registrations down per step there
+        instead of warm-caching (see CrossSliceAllReduce.__call__)."""
         with self._lock:
             if va in self._adopted:
                 self._adopted[va] = (None, self._adopted[va][1])
@@ -192,21 +219,25 @@ class TPUExporter(MemoryExporter):
         self._drop_dead_gaps_in(va, va + nbytes)
         trace.event("tpu.release", va=va, revoked=len(doomed))
 
-    def _containing(self, va: int) -> Optional[Tuple[int, int]]:
+    def _containing(self, va: int, size: int = 1) -> Optional[Tuple[int, int]]:
+        """First adoption FULLY covering [va, va+size). Full-cover (not
+        first-touch) matching matters: adopted ranges from successive
+        allocator layouts can overlap, and a stale smaller range that
+        merely contains ``va`` must not shadow the live one that covers
+        the whole request."""
         for base, (_, nbytes) in self._adopted.items():
-            if base <= va < base + nbytes:
+            if base <= va and va + size <= base + nbytes:
                 return base, nbytes
         return None
 
     def is_device_address(self, va: int, size: int = 1) -> bool:
         with self._lock:
-            hit = self._containing(va)
-            return hit is not None and va + size <= hit[0] + hit[1]
+            return self._containing(va, size) is not None
 
     def get_pages(self, va, size, free_callback=None, client_priv=None):
         with self._lock:
-            hit = self._containing(va)
-            if hit is None or va + size > hit[0] + hit[1]:
+            hit = self._containing(va, size)
+            if hit is None:
                 raise HbmError(f"get_pages: [{va:#x},+{size}) not adopted")
             pages = []
             off = va

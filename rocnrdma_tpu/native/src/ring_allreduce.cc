@@ -331,6 +331,12 @@ struct StepPipe {
       return n;
     };
 
+    // In-flight send bound: the schedule is symmetric, so the peer's
+    // reduce-recv window (≈ ours, same config) caps how many phase-1
+    // sends can land — racing further ahead just RNR-NAK-storms a
+    // real HCA (the mock and emu absorb it, hiding the collapse).
+    const size_t send_win =
+        reduce ? reduce_recv_window(r->right) : kMaxOutstanding;
     while (done_r < n_recv || acked_s < n_send) {
       // Keep outbound traffic moving: in stream mode this blocks while
       // the chunk drains into the socket (the progress thread lands
@@ -340,7 +346,7 @@ struct StepPipe {
       // peer's posted recvs; racing ahead would push inbound messages
       // onto the unexpected (bounce-buffer) path and double-copy them.
       bool may_send = posted_s < n_send &&
-                      posted_s - acked_s < kMaxOutstanding &&
+                      posted_s - acked_s < send_win &&
                       (!windowed || n_recv == 0 || posted_s < done_r + slots);
       if (may_send) {
         size_t len = chunk_len(send_len, posted_s);
@@ -476,11 +482,14 @@ struct FusedTwo {
         if (post_recv_a(posted_rA) != 0) return -1;
     if (use_fb) done_rA = n_a;          // stream does not exist
     const size_t need_sB = use_fb ? 0 : n_b;  // ditto
+    // A-chunks land in the peer's reduce-recvs: bound in-flight sends
+    // by its window (≈ ours) so a real HCA doesn't RNR-NAK-storm.
+    const size_t sa_win = reduce_recv_window(r->right);
 
     while (done_rB < n_b || acked_sB < need_sB || done_rA < n_a ||
            acked_sA < n_a) {
       bool progressed = false;
-      if (posted_sA < n_a && posted_sA - acked_sA < kMaxOutstanding) {
+      if (posted_sA < n_a && posted_sA - acked_sA < sa_win) {
         int rc = use_fb
                      ? tdr_post_send_foldback(r->right, dmr,
                                               a_off + posted_sA * chunk,
@@ -619,7 +628,9 @@ struct Wavefront {
       }
       // Post sends strictly in schedule order as their dependency
       // (the same-segment recv of the previous step) completes.
-      while (posted_s < N && posted_s - acked_s < kMaxOutstanding &&
+      // In-flight sends bounded by the peer's recv window (≈ r_win;
+      // symmetric schedule) to avoid RNR storms on real HCAs.
+      while (posted_s < N && posted_s - acked_s < r_win &&
              done_r >= sends[posted_s].dep) {
         if (post_send_item(posted_s) != 0) return -1;
         posted_s++;

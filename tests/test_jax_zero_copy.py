@@ -147,6 +147,32 @@ def test_shard_regions_rejects_foreign():
     assert nbytes == 32 and va != 0
 
 
+def test_stale_overlapping_adoption_does_not_shadow():
+    """Allocator churn regression: after XLA hands a dead layout's
+    memory to a new buffer, the stale adopted range overlapping the
+    new one must neither shadow it in the containment lookup (the
+    old first-touch bug: 'is not exporter memory' for a freshly
+    adopted region) nor linger in the table."""
+    exp = TPUExporter()
+    # Old layout: a small leaf at base+0x40.
+    exp.adopt_region(0x10040, 256)
+    exp.unhold(0x10040)
+    # New layout: a big leaf at base, overlapping the stale range.
+    exp.adopt_region(0x10000, 16384)
+    assert exp.is_device_address(0x10000, 16384)
+    # The stale overlapping entry (no pins) must be pruned.
+    assert 0x10040 not in exp._adopted
+    # Pinned stale ranges survive pruning (their cached registration
+    # still references these pages) but must not shadow either.
+    exp2 = TPUExporter()
+    exp2.adopt_region(0x20040, 256)
+    pin = exp2.get_pages(0x20040, 256)
+    exp2.adopt_region(0x20000, 16384)
+    assert exp2.is_device_address(0x20000, 16384)
+    assert 0x20040 in exp2._adopted
+    exp2.put_pages(pin)
+
+
 def test_schedule_mismatch_fails_fast():
     """Ranks calling with different layouts (sizes/residency) get an
     immediate TransportError from the schedule-digest handshake — not
@@ -173,6 +199,44 @@ def test_schedule_mismatch_fails_fast():
     for e in errs:
         assert "schedule mismatch" in str(e)
         assert "Local layout" in str(e)
+    close_all(worlds, shims)
+
+
+def test_schedule_check_amortized_steady_state():
+    """Steady-state calls with an unchanged schedule skip the digest
+    exchange (post only ring work requests); a changed schedule
+    re-runs it — and still fails fast when ranks diverge."""
+    from rocnrdma_tpu.transport.engine import TransportError
+
+    worlds, shims = make_world2()
+
+    def n_events(name):
+        return sum(1 for _, n, _ in trace.events() if n == name)
+
+    tree1 = jnp.ones((64,))
+    run_ranks(worlds, lambda w, r: shims[r](tree1))
+    assert n_events("world.sched_check") == 2  # one full exchange/rank
+    run_ranks(worlds, lambda w, r: shims[r](tree1))
+    assert n_events("world.sched_check") == 2  # skipped
+    assert n_events("world.sched_cached") == 2
+
+    # Identical change on all ranks: re-exchanges, verifies, passes.
+    tree2 = jnp.ones((128,))
+    run_ranks(worlds, lambda w, r: shims[r](tree2))
+    assert n_events("world.sched_check") == 4
+
+    # Divergence (both ranks changed, differently): fails fast.
+    trees = [jnp.ones((32,)), jnp.ones((48,))]
+    errs = [None, None]
+
+    def step(w, r):
+        try:
+            shims[r](trees[r])
+        except TransportError as e:
+            errs[r] = e
+
+    run_ranks(worlds, step)
+    assert all(errs), errs
     close_all(worlds, shims)
 
 

@@ -1,0 +1,115 @@
+"""Multi-host-shaped transport test: two network namespaces.
+
+SURVEY.md §4 prescribes multi-host testing via network namespaces —
+the closest hardware-free analogue of two hosts: each rank runs in its
+own netns with its own interface and IP, traffic crosses a veth link,
+and the CMA (same-address-space) tier is explicitly disabled so the
+bytes take the STREAM path a real DCN hop would (the emu handshake
+would otherwise detect same-host and shortcut through process memory).
+
+Skips — with the observed reason — where namespace creation is not
+permitted (unprivileged CI).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+NS = ("tdrtest_a", "tdrtest_b")
+IPS = ("10.97.3.1", "10.97.3.2")
+VETH = ("tdrtest_v0", "tdrtest_v1")
+
+
+def _run(cmd, **kw):
+    return subprocess.run(cmd, capture_output=True, text=True, **kw)
+
+
+def _netns_available():
+    if shutil.which("ip") is None:
+        return "iproute2 'ip' not installed"
+    probe = _run(["ip", "netns", "add", "tdrtest_probe"])
+    if probe.returncode != 0:
+        return f"ip netns add failed: {probe.stderr.strip()}"
+    _run(["ip", "netns", "del", "tdrtest_probe"])
+    return None
+
+
+_SKIP_REASON = _netns_available()
+
+RANK_SCRIPT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["TDR_NO_CMA"] = "1"   # force the stream (network) tier
+    import numpy as np
+    from rocnrdma_tpu.collectives.world import RingWorld
+    from rocnrdma_tpu.transport.engine import Engine
+
+    rank = int(sys.argv[1])
+    world = RingWorld(Engine("emu"), rank, 2, {port}, peers={peers!r},
+                      bind_host="0.0.0.0")
+    buf = np.full(100003, float(rank + 1), dtype=np.float32)
+    world.allreduce(buf)
+    assert np.all(buf == 3.0), buf[:8]
+    # Second allreduce on the same buffer: steady-state (registered)
+    buf[:] = float(rank + 10)
+    world.allreduce(buf)
+    assert np.all(buf == 21.0), buf[:8]
+    world.close()
+    print(f"rank {{rank}} OK")
+""")
+
+
+def _cleanup():
+    for ns in NS:
+        _run(["ip", "netns", "del", ns])
+
+
+@pytest.mark.skipif(_SKIP_REASON is not None,
+                    reason=f"netns unavailable: {_SKIP_REASON}")
+def test_two_netns_ring_allreduce(tmp_path):
+    _cleanup()
+    try:
+        for ns in NS:
+            r = _run(["ip", "netns", "add", ns])
+            assert r.returncode == 0, r.stderr
+        r = _run(["ip", "link", "add", VETH[0], "type", "veth",
+                  "peer", "name", VETH[1]])
+        assert r.returncode == 0, r.stderr
+        for i in range(2):
+            assert _run(["ip", "link", "set", VETH[i],
+                         "netns", NS[i]]).returncode == 0
+            assert _run(["ip", "netns", "exec", NS[i], "ip", "addr",
+                         "add", f"{IPS[i]}/24", "dev",
+                         VETH[i]]).returncode == 0
+            assert _run(["ip", "netns", "exec", NS[i], "ip", "link",
+                         "set", VETH[i], "up"]).returncode == 0
+            assert _run(["ip", "netns", "exec", NS[i], "ip", "link",
+                         "set", "lo", "up"]).returncode == 0
+
+        port = 26000 + (os.getpid() % 600)
+        script = tmp_path / "rank.py"
+        script.write_text(RANK_SCRIPT.format(repo=REPO, port=port,
+                                             peers=list(IPS)))
+        procs = [
+            subprocess.Popen(
+                ["ip", "netns", "exec", NS[r], sys.executable,
+                 str(script), str(r)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            for r in range(2)
+        ]
+        outs = [p.communicate(timeout=120) for p in procs]
+        for r, (p, (out, err)) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, (
+                f"rank {r} failed:\nstdout: {out}\nstderr: {err[-2000:]}")
+            assert f"rank {r} OK" in out
+    finally:
+        _cleanup()

@@ -1,0 +1,45 @@
+#!/bin/sh
+# SoftRoCE bring-up attempt (SURVEY.md §4: rdma_rxe integration testing
+# without a real NIC). Tries to create an rxe device over each
+# candidate netdev and REPORTS THE KERNEL'S ANSWER either way — on
+# kernels/containers without NETLINK_RDMA or the rxe module, the
+# constraint is recorded instead of silently skipped.
+#
+# Exit 0 = an rxe device exists (created here or pre-existing);
+# exit 1 = not possible, with the reason on stdout.
+set -u
+
+if ! command -v rdma >/dev/null 2>&1; then
+    echo "softroce: FAIL — iproute2 'rdma' tool not installed"
+    exit 1
+fi
+
+if rdma link show 2>/dev/null | grep -q .; then
+    echo "softroce: OK — RDMA link already present:"
+    rdma link show
+    exit 0
+fi
+
+err=$(rdma link show 2>&1 >/dev/null)
+case "$err" in
+    *NETLINK_RDMA*)
+        echo "softroce: FAIL — kernel lacks NETLINK_RDMA ($err)." \
+             "This container's kernel has no RDMA netlink family, so" \
+             "rxe can neither be created nor enumerated here. On a" \
+             "stock kernel: modprobe rdma_rxe && rdma link add rxe0" \
+             "type rxe netdev <if>."
+        exit 1
+        ;;
+esac
+
+for dev in $(ls /sys/class/net 2>/dev/null); do
+    out=$(rdma link add tdr_rxe0 type rxe netdev "$dev" 2>&1)
+    if [ $? -eq 0 ]; then
+        echo "softroce: OK — created tdr_rxe0 over $dev"
+        rdma link show
+        exit 0
+    fi
+    echo "softroce: 'rdma link add ... netdev $dev' -> $out"
+done
+echo "softroce: FAIL — no netdev accepted an rxe link (answers above)"
+exit 1

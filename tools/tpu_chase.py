@@ -7,8 +7,9 @@ attempts third-party-verifiable: it retries the TPU sub-benches on an
 interval, appends one JSON line per attempt (timestamp, outcome, error)
 to TPU_ATTEMPTS_r04.jsonl, and writes the full results to
 TPU_RESULTS_r04.json the first time the tunnel answers. bench.py folds
-the banked results into its output (labeled with their capture time)
-when a live probe fails at bench time.
+the banked results into its output as ``details["tpu_banked"]``
+(labeled with their capture time) when a live probe fails at bench
+time — see bench_tpu_details.
 
 Each attempt runs the probe in a SUBPROCESS with a hard timeout —
 a hung jax.devices() can only burn its own interpreter.
