@@ -303,16 +303,30 @@ def bench_tpu_details(probe_timeout_s=120, bench_timeout_s=600):
 
 def main():
     details = {}
-    from rocnrdma_tpu.transport.engine import copy_pool_workers
+    from rocnrdma_tpu.transport.engine import copy_counters, copy_pool_workers
 
     details["copy_pool_workers"] = copy_pool_workers()
     memcpy, fold = bench_roofline()
     details["roofline_memcpy_GBps"] = memcpy
     details["roofline_fold_GBps"] = fold
+    nt0, plain0 = copy_counters()
     details["p2p_write_GBps"] = round(bench_p2p_write(), 3)
+    nt1, plain1 = copy_counters()
+    # Which copy tier carried the p2p bytes (the r03 8.6-vs-15.8
+    # same-size discrepancy was a tier split: ≥64 MiB fell back to
+    # cached memcpy while the sweep's mid sizes streamed).
+    details["p2p_copy_tier"] = {"nt_bytes": nt1 - nt0,
+                                "plain_bytes": plain1 - plain0}
     bus = bench_allreduce()
     details["allreduce_world"] = 2
     details["allreduce_bytes"] = 1 << 30
+    # world>2 datapoint (wavefront schedule with last-RS-step
+    # foldback): smaller buffer so four in-process ranks stay within
+    # the CI box. Same bus-bandwidth convention and roofline context
+    # as the headline.
+    details["allreduce_world4_bus_GBps"] = round(
+        bench_allreduce(count=(256 << 20) // 4, world=4, iters=2), 3)
+    details["allreduce_world4_bytes"] = 256 << 20
     details["sweep_write"] = bench_sweep()
     if os.environ.get("TDR_BENCH_NO_TPU", "0") in ("", "0"):
         details.update(bench_tpu_details())

@@ -342,9 +342,19 @@ class CrossSliceAllReduce:
         # residency) or the ring desynchronizes into a stall.
         import hashlib
 
+        # The wavefront's last-RS-foldback transformation is gated on
+        # BOTH neighbor QPs having negotiated foldback; a ring where
+        # ranks disagree (per-rank TDR_NO_FOLDBACK) would silently
+        # desynchronize, so the gating condition is part of the digest
+        # and divergence fails fast instead.
+        wfb = int(
+            getattr(self.world, "left_qp", None) is not None
+            and self.world.left_qp.has_send_foldback
+            and self.world.right_qp.has_send_foldback
+            and os.environ.get("TDR_NO_WAVE_FB", "0") in ("", "0"))
         sched = [f"world={self.world.world}",
                  f"chunk={os.environ.get('TDR_RING_CHUNK', '')}",
-                 f"mean={int(self.mean)}"]
+                 f"mean={int(self.mean)}", f"wfb={wfb}"]
         sched += [f"z:{nbytes}:{arr.dtype}" for _, nbytes, arr in coalesced]
         sched += [f"j:{nbytes}:{buf.dtype}" for _, nbytes, buf in jax_ops]
         # Per-leaf sizes (not just the sum): ranks with different

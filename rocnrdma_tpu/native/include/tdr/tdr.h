@@ -79,6 +79,11 @@ const char *tdr_last_error(void);
  * (the emulated NIC's DMA-engine array; TDR_COPY_THREADS overrides). */
 size_t tdr_copy_pool_workers(void);
 
+/* Cumulative bytes moved via the streaming (non-temporal) vs cached
+ * (memcpy) copy tiers since process start — which path carried the
+ * traffic (bench/diagnostics). */
+void tdr_copy_counters(uint64_t *nt_bytes, uint64_t *plain_bytes);
+
 /* spec: "emu", "verbs", "verbs:<device>", or "auto" (verbs, else emu). */
 tdr_engine *tdr_engine_open(const char *spec);
 void tdr_engine_close(tdr_engine *e);

@@ -16,6 +16,10 @@ const char *tdr_last_error(void) { return tdr::get_error(); }
 
 size_t tdr_copy_pool_workers(void) { return tdr::copy_pool_workers(); }
 
+void tdr_copy_counters(uint64_t *nt_bytes, uint64_t *plain_bytes) {
+  tdr::copy_counters(nt_bytes, plain_bytes);
+}
+
 tdr_engine *tdr_engine_open(const char *spec) {
   std::string s = spec ? spec : "auto";
   std::string err;

@@ -137,6 +137,10 @@ void reduce2_any(void *dst, void *src, size_t n, int dt, int op);
 // the serial path on 1-core machines or short lengths; parallel
 // reductions are bit-exact with serial ones (element-disjoint slices).
 size_t copy_pool_workers();
+// Cumulative bytes moved via the streaming (non-temporal) vs cached
+// (memcpy) copy tiers — bench/diagnostic visibility into which path
+// carried the traffic.
+void copy_counters(uint64_t *nt, uint64_t *plain);
 void par_memcpy(void *dst, const void *src, size_t len);
 void par_reduce(void *dst, const void *src, size_t n, int dt, int op);
 // Cross-memory attach primitives (single copy between address spaces)
@@ -158,11 +162,6 @@ void copy_nt(char *dst, const char *src, size_t len);
 // and src (streamed) — the one-pass kernel behind send_foldback when
 // both buffers are in this address space.
 void par_reduce2_local(void *dst, void *src, size_t n, int dt, int op);
-// Cross-process variant: fold peer bytes at `src` (pid's address
-// space) into dst, writing the folded result back to the peer — one
-// windowed pass. Returns false on CMA failure.
-bool par_cma_reduce2(pid_t pid, void *dst, uint64_t src, size_t bytes,
-                     int dt, int op);
 
 // TCP helpers (bootstrap for both backends; data path for emu).
 int tcp_listen_accept(const char *bind_host, int port, std::string *err);

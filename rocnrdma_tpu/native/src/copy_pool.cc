@@ -105,18 +105,34 @@ void copy_nt(char *dst, const char *src, size_t len) {
 namespace {
 // Streaming pays off once the destination clearly exceeds L1/L2-hot
 // sizes; below this plain memcpy wins (and keeps the bytes cached).
-// Above kNtCeiling, libc's own memcpy has already switched to its
-// (prefetching, better-scheduled) non-temporal path — defer to it.
+// There is deliberately NO upper ceiling: the previous 64 MiB cutoff
+// assumed libc memcpy switches to non-temporal stores for huge copies
+// — measured false on this class of host (1 GiB memcpy: 3.5 GB/s vs
+// 8.1 GB/s streamed; the RFO traffic of cached stores doubles the
+// effective bytes), and it was the reason bench sizes ≥ 64 MiB fell
+// off a cliff while the 512 KiB–64 MiB tier ran 1.5–2× faster.
 constexpr size_t kNtThreshold = 512u << 10;
-constexpr size_t kNtCeiling = 64u << 20;
+
+// Per-tier byte counters (bench/diagnostics: which copy path carried
+// the traffic — tdr_copy_counters).
+std::atomic<uint64_t> g_nt_bytes{0};
+std::atomic<uint64_t> g_plain_bytes{0};
 
 inline void fast_copy(void *dst, const void *src, size_t len) {
-  if (len >= kNtThreshold && len < kNtCeiling)
+  if (len >= kNtThreshold) {
+    g_nt_bytes.fetch_add(len, std::memory_order_relaxed);
     copy_nt(static_cast<char *>(dst), static_cast<const char *>(src), len);
-  else
+  } else {
+    g_plain_bytes.fetch_add(len, std::memory_order_relaxed);
     memcpy(dst, src, len);
+  }
 }
 }  // namespace
+
+void copy_counters(uint64_t *nt, uint64_t *plain) {
+  if (nt) *nt = g_nt_bytes.load(std::memory_order_relaxed);
+  if (plain) *plain = g_plain_bytes.load(std::memory_order_relaxed);
+}
 
 bool cma_copy_from(pid_t pid, void *dst, uint64_t src, size_t len) {
   if (pid == kCmaSameProcess) {
@@ -298,45 +314,6 @@ void par_reduce2_local(void *dst, void *src, size_t n, int dt, int op) {
   });
 }
 
-// Cross-process exchange fold: pull a window of peer bytes, fold it
-// into dst while writing the folded values back into the window, and
-// push the window back — one pass over dst, two kernel copies of the
-// (cache-resident) window.
-bool par_cma_reduce2(pid_t pid, void *dst, uint64_t src, size_t bytes,
-                     int dt, int op) {
-  size_t esz = dtype_size(dt);
-  if (esz == 0 || bytes % esz != 0) return false;
-  if (pid == kCmaSameProcess) {
-    par_reduce2_local(dst, reinterpret_cast<void *>(src), bytes / esz, dt,
-                      op);
-    return true;
-  }
-  std::atomic<bool> ok{true};
-  size_t grain = kGrain - kGrain % esz;
-  CopyPool::instance().parfor(bytes, grain, [&](size_t b, size_t e) {
-    char window[256 << 10];
-    const size_t step = sizeof(window) - sizeof(window) % esz;
-    char *d = static_cast<char *>(dst) + b;
-    uint64_t s = src + b;
-    size_t left = e - b;
-    while (left > 0) {
-      size_t chunk = left < step ? left : step;
-      if (!cma_copy_from(pid, window, s, chunk)) {
-        ok.store(false, std::memory_order_relaxed);
-        return;
-      }
-      reduce2_any(d, window, chunk / esz, dt, op);
-      if (!cma_copy_to(pid, s, window, chunk)) {
-        ok.store(false, std::memory_order_relaxed);
-        return;
-      }
-      d += chunk;
-      s += chunk;
-      left -= chunk;
-    }
-  });
-  return ok.load();
-}
 
 // dst[i] op= peer_mem[i]: same-process folds read the peer buffer in
 // place; cross-process slices stream through per-slice stack windows

@@ -61,11 +61,23 @@ enum WireOp : uint8_t {
   // folds the payload into its matched recv_reduce buffer and returns
   // the folded result in place over the sender's source. Stream tier:
   // payload follows the FB frame and the folded bytes ride back on
-  // the ack; CMA tier: the receiver's fused kernel writes the peer's
-  // memory directly and the ack is bare.
+  // the ack (a copy independent of the receiver's buffer, so the
+  // receiver completes immediately). CMA tier: three frames — the
+  // receiver folds and offers the result (FB_WB carries its VA), the
+  // sender PULLS it under its own MR validation, then acks
+  // (FB_WB_ACK); the receiver's completion waits for that ack, so it
+  // cannot repurpose the folded buffer (mean-divide, next step)
+  // while the sender's pull is still in flight.
   OP_SEND_FB = 11,
   OP_SEND_FB_DESC = 12,
   OP_SEND_FB_ACK = 13,
+  OP_FB_WB = 14,
+  OP_FB_WB_ACK = 15,
+  // Desc-tier READ: the requester PULLS the bytes (its landing is
+  // validated on its own side) and then acknowledges, releasing the
+  // responder's source inflight ref — without the ack, dereg could
+  // return and the owner reclaim the pages mid-pull.
+  OP_READ_PULLED = 16,
 };
 
 #pragma pack(push, 1)
@@ -145,6 +157,19 @@ std::string read_boot_id() {
 
 bool cma_disabled() { return env_set("TDR_NO_CMA"); }
 
+// Fault injection (tests): widen the window between an inbound
+// message matching a posted recv and the landing-time MR
+// re-validation to a deterministic size, so the free-while-landing
+// interleaving (amdp2p.c:88-109 — the subtlest behavior the
+// reference exists to handle) can be forced rather than raced for.
+void fault_landing_delay() {
+  const char *env = getenv("TDR_FAULT_LANDING_DELAY_MS");
+  if (env && *env) {
+    int ms = atoi(env);
+    if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+  }
+}
+
 // Payload-size sanity cap for wire-controlled allocations (bounced
 // unexpected messages, foldback buffers): a corrupt peer must not be
 // able to bad_alloc the progress thread. Legit messages are ring
@@ -162,19 +187,37 @@ class EmuMr : public Mr {
   EmuEngine *eng = nullptr;
   void *mapped = nullptr;  // dma-buf mmap base (owned), else null
   size_t maplen = 0;
-  // In-flight remote accesses ("NIC" DMA in progress). dereg blocks on
-  // this reaching zero, matching ibv_dereg_mr's guarantee that the NIC
-  // never touches the memory after dereg returns.
+  // In-flight accesses ("NIC" DMA in progress): landing writes into
+  // posted recvs AND pending ops whose local buffer the wire/peer may
+  // still touch (desc-tier sources, READ destinations, foldback
+  // write-back targets). dereg blocks on this reaching zero, matching
+  // ibv_dereg_mr's guarantee that the NIC never touches the memory
+  // after dereg returns.
   std::atomic<int> inflight{0};
-  // Queued-recv references (PostedRecv::mr). Unlike inflight (active
-  // DMA, bounded-time), a queued recv may never match — dereg must
-  // NOT wait for these, so a dereg'd MR with live recv_refs parks in
+  // Object-lifetime references: queued recvs (PostedRecv::mr) AND
+  // pending ops (PendingOp::mr) hold the EmuMr alive so their
+  // completion paths can re-validate through it. Unlike inflight
+  // (active DMA, bounded-time), these may never resolve (a recv that
+  // never matches, a foldback stashed at a dead peer) — dereg must
+  // NOT wait for them, so a dereg'd MR with live recv_refs parks in
   // the engine graveyard instead of being freed.
   std::atomic<int> recv_refs{0};
-  int invalidate() override {
-    valid.store(false, std::memory_order_release);
-    return 0;
-  }
+  // Revocation QUIESCES active copies: mark invalid first (no new
+  // landings start, no new posts accepted), then wait out in-flight
+  // DMA — the owner reclaims the pages only after free_callback
+  // returns, so an invalidate that returned mid-write would hand
+  // reclaimed memory to a still-running copy (the reference's
+  // free_callback contract: KFD reclaims on callback return,
+  // amdp2p.c:105-107, which is only safe because the IB teardown
+  // inside the callback quiesced the NIC first). The wait is bounded:
+  // inflight covers actual copies in progress, never
+  // waiting-for-the-peer state. The engine-mutex barrier between the
+  // store and the wait serializes against landing_begin's
+  // check-then-increment (held under that same mutex): any landing
+  // that read valid==true has raised inflight before the barrier
+  // returns; later ones observe valid==false. Defined out of line —
+  // EmuEngine is incomplete here.
+  int invalidate() override;
   ~EmuMr() override {
     if (mapped) munmap(mapped, maplen);
   }
@@ -308,6 +351,12 @@ class EmuEngine : public Engine {
     if (mr) mr->inflight.fetch_sub(1, std::memory_order_acq_rel);
   }
 
+  // Serialize with any landing_begin in progress: acquiring the mutex
+  // landing_begin holds for its check-then-increment guarantees that
+  // a concurrent landing which read valid==true has already raised
+  // inflight by the time this returns (EmuMr::invalidate's barrier).
+  void quiesce_barrier() { std::lock_guard<std::mutex> g(mu_); }
+
   // Begin a landing write into a posted recv's MR: raise inflight and
   // re-check validity as one step under the engine mutex — the same
   // mutex dereg_mr holds while revoking — so dereg_mr's inflight wait
@@ -354,6 +403,13 @@ struct PendingOp {
   int opcode;     // TDR_OP_*
   char *dst;      // READ destination
   uint64_t len;
+  // Local MR whose memory this op's COMPLETION may write (READ
+  // destination, foldback write-back target). Holds a recv_ref
+  // (object lifetime) from post to completion/flush; the landing at
+  // ack time re-validates through it (landing_begin), so a
+  // revocation in flight fails the op instead of writing reclaimed
+  // memory.
+  EmuMr *mr = nullptr;
 };
 
 // RAII pair for EmuEngine::landing_begin: guarantees the inflight ref
@@ -380,6 +436,14 @@ struct PostedRecv {
   EmuMr *mr = nullptr;
 };
 
+int EmuMr::invalidate() {
+  valid.store(false, std::memory_order_release);
+  if (eng) eng->quiesce_barrier();
+  while (inflight.load(std::memory_order_acquire) > 0)
+    std::this_thread::yield();
+  return 0;
+}
+
 class EmuQp : public Qp {
  public:
   EmuQp(EmuEngine *eng, int fd) : eng_(eng), fd_(fd) {
@@ -395,17 +459,19 @@ class EmuQp : public Qp {
   int post_write(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                  size_t len, uint64_t wr_id) override {
     char *src = eng_->local_ptr(lmr, loff, len);
+    auto *emr = static_cast<EmuMr *>(lmr);
     if (!src) {
       set_error("post_write: invalid local MR range");
       return -1;
     }
+    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
     FrameHdr h{};
     h.op = cma_ ? OP_WRITE_DESC : OP_WRITE;
     h.rkey = rkey;
     h.raddr = raddr;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(src);
-    h.seq = new_pending(wr_id, TDR_OP_WRITE, nullptr, len);
+    h.seq = new_pending(wr_id, TDR_OP_WRITE, nullptr, len, emr);
     bool ok = cma_ ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
     if (!ok) return fail_pending(h.seq);
     return 0;
@@ -414,32 +480,36 @@ class EmuQp : public Qp {
   int post_read(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                 size_t len, uint64_t wr_id) override {
     char *dst = eng_->local_ptr(lmr, loff, len);
+    auto *emr = static_cast<EmuMr *>(lmr);
     if (!dst) {
       set_error("post_read: invalid local MR range");
       return -1;
     }
+    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
     FrameHdr h{};
     h.op = cma_ ? OP_READ_REQ_DESC : OP_READ_REQ;
     h.rkey = rkey;
     h.raddr = raddr;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(dst);
-    h.seq = new_pending(wr_id, TDR_OP_READ, dst, len);
+    h.seq = new_pending(wr_id, TDR_OP_READ, dst, len, emr);
     if (!send_frame(h, nullptr, 0)) return fail_pending(h.seq);
     return 0;
   }
 
   int post_send(Mr *lmr, size_t loff, size_t len, uint64_t wr_id) override {
     char *src = eng_->local_ptr(lmr, loff, len);
+    auto *emr = static_cast<EmuMr *>(lmr);
     if (!src) {
       set_error("post_send: invalid local MR range");
       return -1;
     }
+    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
     FrameHdr h{};
     h.op = cma_ ? OP_SEND_DESC : OP_SEND;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(src);
-    h.seq = new_pending(wr_id, TDR_OP_SEND, nullptr, len);
+    h.seq = new_pending(wr_id, TDR_OP_SEND, nullptr, len, emr);
     bool ok = cma_ ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
     if (!ok) return fail_pending(h.seq);
     return 0;
@@ -463,18 +533,22 @@ class EmuQp : public Qp {
       return -1;
     }
     char *src = eng_->local_ptr(lmr, loff, len);
+    auto *emr = static_cast<EmuMr *>(lmr);
     if (!src) {
       set_error("post_send_foldback: invalid local MR range");
       return -1;
     }
+    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
     FrameHdr h{};
     h.op = cma_ ? OP_SEND_FB_DESC : OP_SEND_FB;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(src);
-    // dst = src: the folded result lands back over the source region
-    // (stream tier reads the ack payload into it; CMA tier is written
-    // remotely and the pending needs no landing).
-    h.seq = new_pending(wr_id, TDR_OP_SEND, src, len);
+    // dst = src: the folded result lands back over the source region.
+    // Stream tier: the ack payload is read into it; CMA tier: PULLED
+    // from the receiver's folded buffer. Both landings re-validate
+    // the MR at copy time (the ack handler's landing_begin), so a
+    // revocation in flight fails the op instead of scribbling.
+    h.seq = new_pending(wr_id, TDR_OP_SEND, src, len, emr);
     bool ok = cma_ ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
     if (!ok) return fail_pending(h.seq);
     return 0;
@@ -587,6 +661,7 @@ class EmuQp : public Qp {
   // returned on the ack (stream tier). Returns the ack write's
   // success.
   bool finish_foldback(const PostedRecv &r, Unexpected &u) {
+    fault_landing_delay();
     FrameHdr ack{};
     ack.op = OP_SEND_FB_ACK;
     ack.seq = u.seq;
@@ -605,18 +680,35 @@ class EmuQp : public Qp {
       return sent;
     }
     if (u.desc) {
-      bool ok = par_cma_reduce2(peer_pid_, r.dst, u.src_va, u.len, r.dtype,
-                                r.red_op);
-      ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
-      sent = send_frame(ack, nullptr, 0);
-      push_wc({r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
-               TDR_OP_RECV, u.len});
-      return sent;
+      // Fold the peer's bytes into OUR buffer (validated above). The
+      // write-back is a PULL by the sender — its write into its own
+      // source region runs under its own MR validation, not blind
+      // from here — and OUR completion waits for its FB_WB_ACK: the
+      // folded bytes must stay untouched (no mean-divide, no next
+      // step) until the pull has landed.
+      bool ok = par_cma_reduce_from(peer_pid_, r.dst, u.src_va, u.len,
+                                    r.dtype, r.red_op);
+      if (!ok) {
+        ack.status = TDR_WC_GENERAL_ERR;
+        sent = send_frame(ack, nullptr, 0);
+        push_wc({r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, u.len});
+        return sent;
+      }
+      FrameHdr wb{};
+      wb.op = OP_FB_WB;
+      wb.seq = u.seq;
+      wb.len = u.len;
+      wb.aux = reinterpret_cast<uint64_t>(r.dst);
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        fb_waiting_[u.seq] = {r.wr_id, u.len};
+      }
+      return send_frame(wb, nullptr, 0);
     }
     // Stream tier: fold the payload in place (it ends up holding the
     // folded values) and return it on the ack. Parallel fold — MB-sized
     // chunks must not serialize on the progress thread when every other
-    // landing path (par_reduce, par_cma_reduce2) uses the copy pool.
+    // landing path (par_reduce, par_cma_reduce_from) uses the copy pool.
     par_reduce2_local(r.dst, u.payload.data(),
                       u.len / dtype_size(r.dtype), r.dtype, r.red_op);
     ack.status = TDR_WC_SUCCESS;
@@ -631,6 +723,7 @@ class EmuQp : public Qp {
   // handle_send_inbound for why delivery is deferred).
   tdr_wc deliver_buffer_wc(const PostedRecv &r, const char *data,
                            size_t len) {
+    fault_landing_delay();
     if (len > r.maxlen ||
         (r.is_reduce && len % dtype_size(r.dtype) != 0))
       return {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
@@ -652,6 +745,7 @@ class EmuQp : public Qp {
   // reduction, no scratch allocation. Returns false only on
   // connection loss.
   bool land_stream_wc(const PostedRecv &r, uint64_t len, tdr_wc *wc) {
+    fault_landing_delay();
     if (len > r.maxlen ||
         (r.is_reduce && len % dtype_size(r.dtype) != 0) ||
         !eng_->landing_begin(r.mr)) {
@@ -687,6 +781,7 @@ class EmuQp : public Qp {
   // Returns whether the data movement succeeded (the ack status).
   bool land_cma_wc(const PostedRecv &r, uint64_t src, uint64_t len,
                    tdr_wc *wc) {
+    fault_landing_delay();
     if (len > r.maxlen ||
         (r.is_reduce && len % dtype_size(r.dtype) != 0) ||
         !eng_->landing_begin(r.mr)) {
@@ -769,11 +864,19 @@ class EmuQp : public Qp {
     cma_ = my_ok && peer_res.cma_ok;
   }
 
-  uint64_t new_pending(uint64_t wr_id, int opcode, char *dst, uint64_t len) {
+  // Caller already holds a recv_ref on `mr` (object-lifetime, see
+  // EmuMr); ownership passes to the pending entry and is dropped at
+  // completion, failure, or flush.
+  uint64_t new_pending(uint64_t wr_id, int opcode, char *dst, uint64_t len,
+                       EmuMr *mr) {
     std::lock_guard<std::mutex> g(mu_);
     uint64_t seq = next_seq_++;
-    pending_[seq] = {wr_id, opcode, dst, len};
+    pending_[seq] = {wr_id, opcode, dst, len, mr};
     return seq;
+  }
+
+  static void release_pending_mr(EmuMr *mr) {
+    if (mr) mr->recv_refs.fetch_sub(1, std::memory_order_acq_rel);
   }
 
   int fail_pending(uint64_t seq) {
@@ -782,6 +885,7 @@ class EmuQp : public Qp {
     if (it != pending_.end()) {
       cq_.push_back({it->second.wr_id, TDR_WC_FLUSH_ERR,
                      it->second.opcode, 0});
+      release_pending_mr(it->second.mr);
       pending_.erase(it);
       cv_.notify_all();
     }
@@ -1016,15 +1120,35 @@ class EmuQp : public Qp {
           FrameHdr resp{};
           resp.op = OP_READ_RESP;
           resp.seq = h.seq;
-          resp.len = 0;  // bytes moved via CMA, none follow on the wire
+          resp.len = 0;  // bytes move via CMA, none follow on the wire
           if (src) {
-            bool ok = par_cma_copy_to(peer_pid_, h.aux, src, h.len);
-            EmuEngine::dma_done(tmr);
-            resp.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
+            // The REQUESTER pulls the bytes (its landing into its own
+            // MR is validated there); pushing into the requester's
+            // memory from here would write a buffer whose validity
+            // only the requester can check. The source's inflight ref
+            // is held until the requester's OP_READ_PULLED ack, so
+            // dereg/invalidate quiesce across the pull.
+            resp.status = TDR_WC_SUCCESS;
+            resp.aux = reinterpret_cast<uint64_t>(src);
+            std::lock_guard<std::mutex> g(mu_);
+            read_srcs_[h.seq] = tmr;
           } else {
             resp.status = TDR_WC_REM_ACCESS_ERR;
           }
           if (!send_frame(resp, nullptr, 0)) goto out;
+          break;
+        }
+        case OP_READ_PULLED: {
+          EmuMr *tmr = nullptr;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            auto it = read_srcs_.find(h.seq);
+            if (it != read_srcs_.end()) {
+              tmr = it->second;
+              read_srcs_.erase(it);
+            }
+          }
+          EmuEngine::dma_done(tmr);
           break;
         }
         case OP_SEND_DESC: {
@@ -1042,26 +1166,95 @@ class EmuQp : public Qp {
           break;
         }
         case OP_SEND_FB_ACK: {
-          // Stream-tier acks carry the folded result; land it over
-          // the pending send's source region (the in-place final).
+          // Land the folded result over the pending send's source
+          // region (the in-place final): stream tier carries it as
+          // the ack payload; CMA tier PULLS it from the receiver's
+          // folded buffer (ack.aux). Either way the landing
+          // re-validates the MR first — a revocation between post
+          // and ack must fail the op, never write reclaimed memory.
           char *dst = nullptr;
           uint64_t want = 0;
+          EmuMr *pmr = nullptr;
           {
             std::lock_guard<std::mutex> g(mu_);
             auto it = pending_.find(h.seq);
             if (it != pending_.end()) {
               dst = it->second.dst;
               want = it->second.len;
+              pmr = it->second.mr;
             }
           }
-          if (h.len) {
-            if (h.status == TDR_WC_SUCCESS && dst && h.len == want) {
-              if (!read_full(fd_, dst, h.len)) goto out;
+          uint8_t st = h.status;
+          if (h.len) {  // stream tier
+            bool can = st == TDR_WC_SUCCESS && dst && h.len == want &&
+                       eng_->landing_begin(pmr);
+            if (can) {
+              bool ok = read_full(fd_, dst, h.len);
+              EmuEngine::dma_done(pmr);
+              if (!ok) goto out;
             } else {
               if (!drain(h.len)) goto out;
+              if (st == TDR_WC_SUCCESS) st = TDR_WC_LOC_ACCESS_ERR;
             }
           }
-          complete_pending(h.seq, h.status, nullptr, 0);
+          complete_pending(h.seq, st, nullptr, 0);
+          break;
+        }
+        case OP_FB_WB: {
+          // Desc-tier foldback write-back offer: PULL the folded
+          // bytes into our pending send's source region — a landing
+          // write, re-validated through the MR — then ack so the
+          // peer's completion (and its freedom to reuse the folded
+          // buffer) unblocks.
+          if (!cma_) goto out;
+          char *dst = nullptr;
+          uint64_t want = 0;
+          EmuMr *pmr = nullptr;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            auto it = pending_.find(h.seq);
+            if (it != pending_.end()) {
+              dst = it->second.dst;
+              want = it->second.len;
+              pmr = it->second.mr;
+            }
+          }
+          fault_landing_delay();
+          uint8_t st = TDR_WC_LOC_ACCESS_ERR;
+          if (dst && h.len == want && eng_->landing_begin(pmr)) {
+            if (par_cma_copy_from(peer_pid_, dst, h.aux, want))
+              st = TDR_WC_SUCCESS;
+            EmuEngine::dma_done(pmr);
+          }
+          FrameHdr ack{};
+          ack.op = OP_FB_WB_ACK;
+          ack.seq = h.seq;
+          ack.status = st;
+          bool sent = send_frame(ack, nullptr, 0);
+          complete_pending(h.seq, st, nullptr, 0);
+          if (!sent) goto out;
+          break;
+        }
+        case OP_FB_WB_ACK: {
+          // The peer's pull finished (or failed): surface the
+          // deferred foldback-recv completion.
+          uint64_t wr_id = 0, len = 0;
+          bool have = false;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            auto it = fb_waiting_.find(h.seq);
+            if (it != fb_waiting_.end()) {
+              wr_id = it->second.first;
+              len = it->second.second;
+              fb_waiting_.erase(it);
+              have = true;
+            }
+          }
+          if (have)
+            push_wc({wr_id,
+                     h.status == TDR_WC_SUCCESS ? TDR_WC_SUCCESS
+                                                : TDR_WC_LOC_ACCESS_ERR,
+                     TDR_OP_RECV, len});
           break;
         }
         case OP_WRITE_ACK:
@@ -1072,22 +1265,43 @@ class EmuQp : public Qp {
         case OP_READ_RESP: {
           char *dst = nullptr;
           uint64_t want = 0;
+          EmuMr *pmr = nullptr;
           {
             std::lock_guard<std::mutex> g(mu_);
             auto it = pending_.find(h.seq);
             if (it != pending_.end()) {
               dst = it->second.dst;
               want = it->second.len;
+              pmr = it->second.mr;
             }
           }
-          if (h.status == TDR_WC_SUCCESS && h.len) {
-            if (dst && h.len == want) {
-              if (!read_full(fd_, dst, h.len)) goto out;
+          uint8_t st = h.status;
+          if (st == TDR_WC_SUCCESS && h.len) {  // stream tier payload
+            bool can = dst && h.len == want && eng_->landing_begin(pmr);
+            if (can) {
+              bool ok = read_full(fd_, dst, h.len);
+              EmuEngine::dma_done(pmr);
+              if (!ok) goto out;
             } else {
               if (!drain(h.len)) goto out;
+              st = TDR_WC_LOC_ACCESS_ERR;
             }
+          } else if (st == TDR_WC_SUCCESS && cma_ && h.aux) {
+            // Desc tier: pull the bytes from the responder's source
+            // (read-only peer access; the local landing is
+            // validated), then release the responder's source ref.
+            bool ok = false;
+            if (dst && eng_->landing_begin(pmr)) {
+              ok = par_cma_copy_from(peer_pid_, dst, h.aux, want);
+              EmuEngine::dma_done(pmr);
+            }
+            if (!ok) st = TDR_WC_LOC_ACCESS_ERR;
+            FrameHdr pulled{};
+            pulled.op = OP_READ_PULLED;
+            pulled.seq = h.seq;
+            if (!send_frame(pulled, nullptr, 0)) goto out;
           }
-          complete_pending(h.seq, h.status, nullptr, 0);
+          complete_pending(h.seq, st, nullptr, 0);
           break;
         }
         case OP_GOODBYE:
@@ -1101,14 +1315,25 @@ class EmuQp : public Qp {
     // RC flush semantics (TDR_WC_FLUSH_ERR).
     std::lock_guard<std::mutex> g(mu_);
     dead_ = true;
-    for (auto &kv : pending_)
+    for (auto &kv : pending_) {
       cq_.push_back({kv.second.wr_id, TDR_WC_FLUSH_ERR, kv.second.opcode, 0});
+      release_pending_mr(kv.second.mr);
+    }
     pending_.clear();
     for (auto &r : recvs_) {
       cq_.push_back({r.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0});
       release_recv(r);
     }
     recvs_.clear();
+    // Foldback recvs whose write-back pull was never acked flush too.
+    for (auto &kv : fb_waiting_)
+      cq_.push_back({kv.second.first, TDR_WC_FLUSH_ERR, TDR_OP_RECV,
+                     kv.second.second});
+    fb_waiting_.clear();
+    // READ sources whose pull was never acked: drop their refs so
+    // dereg doesn't spin on a dead connection.
+    for (auto &kv : read_srcs_) EmuEngine::dma_done(kv.second);
+    read_srcs_.clear();
     cv_.notify_all();
   }
 
@@ -1118,6 +1343,7 @@ class EmuQp : public Qp {
     if (it == pending_.end()) return;
     cq_.push_back({it->second.wr_id, status, it->second.opcode,
                    it->second.len});
+    release_pending_mr(it->second.mr);
     pending_.erase(it);
     cv_.notify_all();
   }
@@ -1139,6 +1365,12 @@ class EmuQp : public Qp {
   std::condition_variable cv_;
   std::deque<tdr_wc> cq_;
   std::unordered_map<uint64_t, PendingOp> pending_;
+  // Desc-tier foldback recvs folded but awaiting the sender's
+  // pull-ack (OP_FB_WB_ACK): seq → (wr_id, len).
+  std::unordered_map<uint64_t, std::pair<uint64_t, uint64_t>> fb_waiting_;
+  // Desc-tier READ sources holding an inflight ref until the
+  // requester's OP_READ_PULLED ack: seq → MR.
+  std::unordered_map<uint64_t, EmuMr *> read_srcs_;
   std::deque<PostedRecv> recvs_;
   std::deque<Unexpected> unexpected_;
   uint64_t next_seq_ = 1;

@@ -564,6 +564,7 @@ struct WaveItem {
   size_t len;
   bool reduce;     // recv side: fold vs place
   size_t dep = 0;  // send side: required done_recv count
+  bool fb = false;  // send side: fold-and-write-back (last RS step)
 };
 
 struct Wavefront {
@@ -573,9 +574,20 @@ struct Wavefront {
   std::vector<WaveItem> sends, recvs;
 
   size_t posted_s = 0, acked_s = 0, posted_r = 0, done_r = 0;
+  // Completion bookkeeping tolerates out-of-schedule-order recv
+  // completions: a foldback recv's completion is DEFERRED until the
+  // peer's write-back pull acks, so a later plain recv can complete
+  // first. Matching is still FIFO at the transport — only the
+  // reporting reorders — and send dependencies use the in-order
+  // completed PREFIX (frontier), never the raw count.
+  std::vector<uint8_t> done_mask;
+  size_t frontier = 0;
 
   int post_send_item(size_t i) {
     const WaveItem &it = sends[i];
+    if (it.fb)
+      return tdr_post_send_foldback(r->right, dmr, it.off, it.len,
+                                    kWrSend | i);
     return tdr_post_send(r->right, dmr, it.off, it.len, kWrSend | i);
   }
   int post_recv_item(size_t i) {
@@ -602,11 +614,14 @@ struct Wavefront {
       if (kind == kWrSend) {
         acked_s++;
       } else if (kind == kWrRecv) {
-        if (idx != done_r) {
-          tdr::set_error("ring(wave): out-of-order recv completion");
+        if (idx >= done_mask.size() || done_mask[idx]) {
+          tdr::set_error("ring(wave): duplicate/foreign recv completion");
           return -1;
         }
+        done_mask[idx] = 1;
         done_r++;
+        while (frontier < done_mask.size() && done_mask[frontier])
+          frontier++;
       }
     }
     return n;
@@ -614,6 +629,7 @@ struct Wavefront {
 
   int run() {
     const size_t N = sends.size(), M = recvs.size();
+    done_mask.assign(M, 0);
     // Mixed reduce/place recv stream: bound the whole window by the
     // engine's reduce-recv budget (conservative for place-only spans,
     // but the window refills as completions retire).
@@ -631,7 +647,7 @@ struct Wavefront {
       // In-flight sends bounded by the peer's recv window (≈ r_win;
       // symmetric schedule) to avoid RNR storms on real HCAs.
       while (posted_s < N && posted_s - acked_s < r_win &&
-             done_r >= sends[posted_s].dep) {
+             frontier >= sends[posted_s].dep) {
         if (post_send_item(posted_s) != 0) return -1;
         posted_s++;
         progressed = true;
@@ -766,20 +782,35 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
         *recv_seg = ((r->rank - s2) % world + world) % world;
       }
     };
-    Wavefront wf{r, dmr, dtype, red_op, {}, {}};
+    // Last-RS-step foldback: the sends of step world-2 become
+    // fold-and-write-back sends, so each rank's fully-reduced owned
+    // segment comes back IN PLACE as the write-back — which is byte-
+    // for-byte what the LAST all-gather step would have delivered.
+    // That whole step (its sends and recvs, one full segment of
+    // traffic and latency per rank) disappears: 2(world-1) steps
+    // become 2*world-3. Every rank must take the same branch: the
+    // gating condition (both neighbor QPs negotiated foldback) is
+    // part of the Python layer's schedule digest, so a ring with
+    // per-rank foldback divergence fails fast instead of
+    // desynchronizing.
+    const bool wave_fb = tdr_qp_has_send_foldback(r->right) &&
+                         tdr_qp_has_send_foldback(r->left) &&
+                         !tdr::env_set("TDR_NO_WAVE_FB");
+    const int eff_steps = wave_fb ? steps - 1 : steps;
+    Wavefront wf{r, dmr, dtype, red_op, {}, {}, 0, 0, 0, 0, {}, 0};
     std::vector<size_t> rprefix(steps + 1, 0);
     for (int t = 0; t < steps; t++) {
       int ss, rs;
       segs_at(t, &ss, &rs);
       rprefix[t + 1] = rprefix[t] + nch(seg_len[rs]);
     }
-    for (int t = 0; t < steps; t++) {
+    for (int t = 0; t < eff_steps; t++) {
       int ss, rs;
       segs_at(t, &ss, &rs);
       const bool fold = t < world - 1;
       for (size_t c = 0; c < nch(seg_len[ss]); c++) {
         WaveItem it{seg_off[ss] + c * chunk, clen(seg_len[ss], c), false,
-                    0};
+                    0, wave_fb && t == world - 2};
         // send (t,c) forwards the bytes recv (t-1,c) produced —
         // send_seg(t) IS recv_seg(t-1) — so its dependency is that
         // many completed receives.

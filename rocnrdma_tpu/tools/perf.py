@@ -58,14 +58,21 @@ def _mr_for(engine, nbytes: int, hbm: str):
     """Buffer + MR via the requested memory source."""
     if hbm == "fake":
         from rocnrdma_tpu.hbm.registry import (
-            FakeHBMExporter, RegistrationManager)
+            FakeHBMExporter, RegistrationManager, as_ndarray)
 
         exporter = FakeHBMExporter()
         mgr = RegistrationManager(engine, exporter)
         va = exporter.alloc(nbytes)
         reg = mgr.register(va, nbytes)
+        as_ndarray(va, (nbytes,), np.uint8)[:] = 0xA5
         return reg.mr, (mgr, reg)
-    buf = np.zeros(nbytes, dtype=np.uint8)
+    # Fill with a real pattern (as ib_write_bw does): an all-zeros
+    # numpy buffer is COW-backed by the kernel ZERO PAGE — every
+    # source page aliases one cached 4 KiB page, reads cost nothing,
+    # and the "bandwidth" reported is write-only traffic, ~2x the
+    # honest read+write number. (This was the r03 sweep-vs-p2p
+    # same-size discrepancy.)
+    buf = np.full(nbytes, 0xA5, dtype=np.uint8)
     return engine.reg_mr(buf), buf  # keep buf alive
 
 

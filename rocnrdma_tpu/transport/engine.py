@@ -98,6 +98,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     P = ctypes.c_void_p
     lib.tdr_last_error.restype = ctypes.c_char_p
     lib.tdr_copy_pool_workers.restype = ctypes.c_size_t
+    lib.tdr_copy_counters.argtypes = [ctypes.POINTER(ctypes.c_uint64),
+                                      ctypes.POINTER(ctypes.c_uint64)]
     lib.tdr_engine_open.restype = P
     lib.tdr_engine_open.argtypes = [ctypes.c_char_p]
     lib.tdr_engine_close.argtypes = [P]
@@ -174,6 +176,15 @@ def copy_pool_workers() -> int:
     """Worker count of the native parallel copy/reduce pool (the
     emulated NIC's DMA-engine array; TDR_COPY_THREADS overrides)."""
     return int(_load().tdr_copy_pool_workers())
+
+
+def copy_counters() -> Tuple[int, int]:
+    """(nt_bytes, plain_bytes) moved via the streaming vs cached copy
+    tiers since process start — which path carried the traffic."""
+    nt = ctypes.c_uint64()
+    plain = ctypes.c_uint64()
+    _load().tdr_copy_counters(ctypes.byref(nt), ctypes.byref(plain))
+    return int(nt.value), int(plain.value)
 
 
 def _check(cond, what: str):

@@ -213,20 +213,27 @@ def test_schedule_check_amortized_steady_state():
     def n_events(name):
         return sum(1 for _, n, _ in trace.events() if n == name)
 
-    tree1 = jnp.ones((64,))
-    run_ranks(worlds, lambda w, r: shims[r](tree1))
+    # Fresh materialized buffers — the shim reduces IN PLACE, and
+    # jnp.ones literals can alias jax's shared constant cache (donation
+    # semantics require exclusive ownership).
+    def fresh(n):
+        return jax.device_put(np.ones(n, dtype=np.float32))
+
+    t1 = [fresh(64), fresh(64)]
+    run_ranks(worlds, lambda w, r: shims[r](t1[r]))
     assert n_events("world.sched_check") == 2  # one full exchange/rank
-    run_ranks(worlds, lambda w, r: shims[r](tree1))
+    t2 = [fresh(64), fresh(64)]
+    run_ranks(worlds, lambda w, r: shims[r](t2[r]))
     assert n_events("world.sched_check") == 2  # skipped
     assert n_events("world.sched_cached") == 2
 
     # Identical change on all ranks: re-exchanges, verifies, passes.
-    tree2 = jnp.ones((128,))
-    run_ranks(worlds, lambda w, r: shims[r](tree2))
+    t3 = [fresh(128), fresh(128)]
+    run_ranks(worlds, lambda w, r: shims[r](t3[r]))
     assert n_events("world.sched_check") == 4
 
     # Divergence (both ranks changed, differently): fails fast.
-    trees = [jnp.ones((32,)), jnp.ones((48,))]
+    trees = [fresh(32), fresh(48)]
     errs = [None, None]
 
     def step(w, r):

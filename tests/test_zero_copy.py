@@ -265,6 +265,47 @@ def test_revocation_invalidates_cached_registration():
     close_all(worlds, shims)
 
 
+def test_revocation_forced_into_landing_window(monkeypatch):
+    """DETERMINISTIC free-while-landing (amdp2p.c:88-109): the fault
+    injection holds the landing path between the recv match and the
+    MR re-validation; the owner frees INSIDE that window. The recv
+    must complete with the lifetime error — if the revocation were
+    not observed at landing time (the bug this interleaving exists to
+    catch), the landing would succeed and this test would fail."""
+    from rocnrdma_tpu.hbm.registry import RegistrationManager
+    from rocnrdma_tpu.transport.engine import (DT_F32, Engine, RED_SUM,
+                                               WC_SUCCESS, loopback_pair)
+
+    monkeypatch.setenv("TDR_FAULT_LANDING_DELAY_MS", "400")
+    e = Engine("emu")
+    exporter = FakeHBMExporter()
+    va = exporter.alloc(4096)
+    mgr = RegistrationManager(e, exporter)
+    reg = mgr.register(va, 4096)
+    a, b = loopback_pair(e, free_port() + 400)
+
+    payload = np.ones(1024, dtype=np.float32)
+    with e.reg_mr(payload) as pmr:
+        b.post_recv_reduce(reg.mr, 0, 4096, DT_F32, RED_SUM, wr_id=1)
+        t0 = time.perf_counter()
+        a.post_send(pmr, 0, payload.nbytes, wr_id=2)
+        # The payload is matched immediately; the landing is now
+        # sleeping. Free the target inside that window.
+        time.sleep(0.1)
+        exporter.free(va)
+        t_free = time.perf_counter() - t0
+        assert t_free < 0.4, f"free happened after the window ({t_free:.2f}s)"
+        assert reg.ctx.revoked  # free_callback fired
+        wc = b.poll(max_wc=1, timeout_ms=10000)
+        assert wc and wc[0].wr_id == 1
+        assert wc[0].status != WC_SUCCESS, (
+            "landing succeeded despite revocation inside the window")
+    a.close()
+    b.close()
+    mgr.close()
+    e.close()
+
+
 def test_revocation_mid_collective_no_crash(monkeypatch):
     """Free a rank's buffer while a large allreduce is in flight: the
     collective either fails with a transport/lifetime error or had
