@@ -47,6 +47,16 @@ TARGET_BUS_GBPS = 0.9 * 12.5
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def bench_roofline(nbytes=256 << 20, iters=5):
     """Single-core memcpy and f32 fold (a += b) GB/s — the memory
     system's answer to 'how fast could ANY allreduce go here'."""
@@ -71,10 +81,7 @@ def bench_p2p_write(size=1 << 30, iters=3):
     """ib_write_bw analogue: one-sided writes, loopback (config 0)."""
     from rocnrdma_tpu.transport.engine import Engine, loopback_pair
 
-    import socket
-
-    s = socket.socket(); s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]; s.close()
+    port = _free_port()
 
     e = Engine("emu")
     a, b = loopback_pair(e, port)
@@ -100,10 +107,7 @@ def bench_allreduce(count=(1 << 30) // 4, world=2, iters=3):
     """2-rank 1 GiB f32 ring allreduce bus bandwidth (config 3 shape)."""
     from rocnrdma_tpu.collectives.world import local_worlds
 
-    import socket
-
-    s = socket.socket(); s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]; s.close()
+    port = _free_port()
 
     worlds = local_worlds(world, port + 1000)
     bufs = [np.ones(count, dtype=np.float32) for _ in range(world)]
@@ -133,13 +137,55 @@ def bench_allreduce(count=(1 << 30) // 4, world=2, iters=3):
     return nbytes * 2 * (world - 1) / world / dt / 1e9
 
 
+def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
+    """Staged-fallback throughput: a pytree of numpy leaves with NO
+    exporter takes the gather → ring → scatter path (the only path
+    real TPU HBM can ride until dma-buf export lands). Measured with
+    and without the D2H/ring/H2D pipeline so its benefit is visible."""
+    import threading as _t
+
+    from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    port = _free_port()
+
+    n = nbytes // 4 // leaves
+    out = {}
+    for mode, env in (("pipelined", "0"), ("serial", "1")):
+        os.environ["TDR_NO_STAGE_PIPELINE"] = env
+        worlds = local_worlds(2, _free_port())
+        shims = [CrossSliceAllReduce(worlds[r]) for r in range(2)]
+        trees = [[np.ones(n, dtype=np.float32) for _ in range(leaves)]
+                 for _ in range(2)]
+
+        def sync_all():
+            ts = [_t.Thread(target=shims[r], args=(trees[r],))
+                  for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        sync_all()  # warmup (registers staging buffers)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            sync_all()
+        dt = (time.perf_counter() - t0) / iters
+        # Useful-bytes convention: the full tree crosses the staged
+        # path once per sync.
+        out[f"staged_{mode}_GBps"] = round(n * 4 * leaves / dt / 1e9, 3)
+        for sh in shims:
+            sh.close()
+        for w in worlds:
+            w.close()
+    os.environ.pop("TDR_NO_STAGE_PIPELINE", None)
+    return out
+
+
 def bench_sweep(timeout_s=300):
     """Config-2: the 4 B–1 GiB message-size sweep (peak bandwidth and
     small-message latency) via the perftest-analogue tool."""
-    import socket
-
-    s = socket.socket(); s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]; s.close()
+    port = _free_port()
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "rocnrdma_tpu.tools.perf", "--loopback",
@@ -327,6 +373,7 @@ def main():
     details["allreduce_world4_bus_GBps"] = round(
         bench_allreduce(count=(256 << 20) // 4, world=4, iters=2), 3)
     details["allreduce_world4_bytes"] = 256 << 20
+    details.update(bench_staged())
     details["sweep_write"] = bench_sweep()
     if os.environ.get("TDR_BENCH_NO_TPU", "0") in ("", "0"):
         details.update(bench_tpu_details())

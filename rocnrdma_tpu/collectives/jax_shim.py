@@ -54,6 +54,8 @@ VA order would desynchronize the ring), then the staged groups.
 from __future__ import annotations
 
 import os
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -125,6 +127,8 @@ class CrossSliceAllReduce:
         # The MR is adopted by the ring; both sides are front-loaded.
         self._regs: Dict[Tuple[int, int], Any] = {}
         self._regmgr: Optional[RegistrationManager] = None
+        # Worker for the staged pipeline's ring ops (lazy).
+        self._stage_ex: Optional[ThreadPoolExecutor] = None
 
     # -------------------------------------------------- zero-copy path
 
@@ -354,6 +358,7 @@ class CrossSliceAllReduce:
             and os.environ.get("TDR_NO_WAVE_FB", "0") in ("", "0"))
         sched = [f"world={self.world.world}",
                  f"chunk={os.environ.get('TDR_RING_CHUNK', '')}",
+                 f"schunk={self._stage_chunk()}",
                  f"mean={int(self.mean)}", f"wfb={wfb}"]
         sched += [f"z:{nbytes}:{arr.dtype}" for _, nbytes, arr in coalesced]
         sched += [f"j:{nbytes}:{buf.dtype}" for _, nbytes, buf in jax_ops]
@@ -422,21 +427,73 @@ class CrossSliceAllReduce:
                             pass
             raise
 
-        # Staged fallback for everything else, packed per dtype.
+        # Staged fallback for everything else, packed per dtype and
+        # PIPELINED: consecutive leaves are batched into segments of
+        # ~TDR_STAGE_CHUNK bytes; a worker thread runs the ring
+        # allreduce of segment k while this thread gathers (D2H +
+        # pack) segment k+1 and scatters (unpack + H2D) segment k-1.
+        # On a real TPU backend this is the only path HBM gradients
+        # can take until dma-buf export lands, so its cost IS the
+        # product's cost there — the overlap hides most of the bounce
+        # the zero-copy path eliminates outright.
         for dtype_str, idxs in groups.items():
-            host_parts = [np.asarray(jax.device_get(leaves[i]))
-                          for i in idxs]
-            shapes = [p.shape for p in host_parts]
-            sizes = [p.size for p in host_parts]
-            total = int(sum(sizes))
-            buf = self._stage(dtype_str, total)
-            offset = 0
-            for p in host_parts:
-                buf[offset:offset + p.size] = p.reshape(-1)
-                offset += p.size
-            flat = buf[:total]
-            staging.add(flat.nbytes * 2)  # D2H + H2D round trip
-            self.world.allreduce(flat, RED_SUM)
+            self._staged_group(jax, leaves, out, dtype_str, idxs)
+        self._evict_cache(used_keys)
+        trace.event("xslice.allreduce", leaves=len(leaves),
+                    zero_copy=n_zero_copy, staged=len(staged_idx))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # ---------------------------------------------- staged pipeline
+
+    def _staged_group(self, jax, leaves, out, dtype_str: str,
+                      idxs: List[int]) -> None:
+        """Gather → ring → scatter for one dtype group, overlapped.
+
+        Ring ops run on a single worker thread in segment order (the
+        identical deterministic order on every rank — the SPMD
+        contract extends to the segment plan, which is derived from
+        leaf sizes and TDR_STAGE_CHUNK, both digest-checked)."""
+        itemsize = np.dtype(dtype_str).itemsize
+        sizes = [int(leaves[i].size) for i in idxs]
+        total = int(sum(sizes))
+        buf = self._stage(dtype_str, total)
+        staging.add(total * itemsize * 2)  # D2H + H2D round trip
+
+        # Kick asynchronous D2H for every device leaf up front so the
+        # per-segment gathers find bytes already on their way.
+        for i in idxs:
+            start_copy = getattr(leaves[i], "copy_to_host_async", None)
+            if start_copy is not None:
+                try:
+                    start_copy()
+                except Exception:
+                    pass  # synchronous device_get below still works
+
+        # Segment plan: consecutive leaves batched to >= chunk elems.
+        chunk_elems = max(1, self._stage_chunk() // itemsize)
+        segs: List[Tuple[int, int, List[int]]] = []
+        start, size, members = 0, 0, []
+        off = 0
+        for i, sz in zip(idxs, sizes):
+            members.append(i)
+            size += sz
+            off += sz
+            if size >= chunk_elems:
+                segs.append((start, size, members))
+                start, size, members = off, 0, []
+        if size:
+            segs.append((start, size, members))
+
+        def gather(seg):
+            o = seg[0]
+            for i in seg[2]:
+                p = np.asarray(jax.device_get(leaves[i])).reshape(-1)
+                buf[o:o + p.size] = p
+                o += p.size
+
+        def scatter(seg):
+            o = seg[0]
+            flat = buf[seg[0]:seg[0] + seg[1]]
             if self.mean:
                 if flat.dtype.kind in "iu":
                     flat //= self.world.world
@@ -444,10 +501,10 @@ class CrossSliceAllReduce:
                     # Divide in the array's own dtype — no silent
                     # downcast of f64 (or upcast of bf16) gradients.
                     flat /= np.asarray(self.world.world, dtype=flat.dtype)
-            offset = 0
-            for i, shape, size in zip(idxs, shapes, sizes):
-                piece = flat[offset:offset + size].reshape(shape).copy()
-                offset += size
+            for i in seg[2]:
+                piece = buf[o:o + leaves[i].size]
+                o += leaves[i].size
+                piece = piece.reshape(np.shape(leaves[i])).copy()
                 if isinstance(leaves[i], np.ndarray):
                     out[i] = piece
                 else:
@@ -455,10 +512,60 @@ class CrossSliceAllReduce:
                     # dp×tp mesh doesn't funnel gradients through one
                     # device.
                     out[i] = jax.device_put(piece, leaves[i].sharding)
-        self._evict_cache(used_keys)
-        trace.event("xslice.allreduce", leaves=len(leaves),
-                    zero_copy=n_zero_copy, staged=len(staged_idx))
-        return jax.tree_util.tree_unflatten(treedef, out)
+
+        pipelined = (len(segs) > 1 and os.environ.get(
+            "TDR_NO_STAGE_PIPELINE", "0") in ("", "0"))
+        if not pipelined:
+            for seg in segs:
+                gather(seg)
+                self.world.allreduce(buf[seg[0]:seg[0] + seg[1]], RED_SUM)
+                scatter(seg)
+            return
+
+        ex = self._stage_ex
+        if ex is None:
+            ex = self._stage_ex = ThreadPoolExecutor(
+                1, thread_name_prefix="tdr-stage")
+        pending: deque = deque()
+        try:
+            for seg in segs:
+                gather(seg)
+                fut = ex.submit(self.world.allreduce,
+                                buf[seg[0]:seg[0] + seg[1]], RED_SUM)
+                pending.append((fut, seg))
+                # Double-buffer: scatter the oldest segment once its
+                # reduction lands (keeping at most two in flight).
+                while len(pending) > 2 or (pending and
+                                           pending[0][0].done()):
+                    done_fut, done_seg = pending.popleft()
+                    done_fut.result()
+                    scatter(done_seg)
+            while pending:
+                done_fut, done_seg = pending.popleft()
+                done_fut.result()
+                scatter(done_seg)
+        except BaseException:
+            # Drain the worker so no ring op runs concurrently with
+            # the caller's error handling / teardown.
+            while pending:
+                fut, _ = pending.popleft()
+                try:
+                    fut.result()
+                except Exception:
+                    pass
+            raise
+
+    @staticmethod
+    def _stage_chunk() -> int:
+        env = os.environ.get("TDR_STAGE_CHUNK", "")
+        if env:
+            try:
+                v = int(env)
+                if v >= 4096:
+                    return v
+            except ValueError:
+                pass
+        return 16 << 20
 
     def _stage(self, dtype_str: str, count: int) -> np.ndarray:
         buf = self._staging.get(dtype_str)
@@ -476,6 +583,9 @@ class CrossSliceAllReduce:
     def close(self) -> None:
         """Release the zero-copy registrations (unadopt from the ring,
         then unpin). Call before tearing down the world."""
+        if self._stage_ex is not None:
+            self._stage_ex.shutdown(wait=True)
+            self._stage_ex = None
         for key in list(self._regs):
             self._drop_cached(key, forget_adoption=False)
         if self._regmgr is not None:

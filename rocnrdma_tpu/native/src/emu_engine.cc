@@ -187,12 +187,17 @@ class EmuMr : public Mr {
   EmuEngine *eng = nullptr;
   void *mapped = nullptr;  // dma-buf mmap base (owned), else null
   size_t maplen = 0;
-  // In-flight accesses ("NIC" DMA in progress): landing writes into
-  // posted recvs AND pending ops whose local buffer the wire/peer may
-  // still touch (desc-tier sources, READ destinations, foldback
-  // write-back targets). dereg blocks on this reaching zero, matching
-  // ibv_dereg_mr's guarantee that the NIC never touches the memory
-  // after dereg returns.
+  // In-flight accesses ("NIC" DMA in progress): every WRITE into this
+  // MR's memory (recv landings, READ-response landings, foldback
+  // write-back pulls) plus reads the protocol explicitly brackets
+  // with an ack (READ sources until OP_READ_PULLED, folded foldback
+  // buffers until OP_FB_WB_ACK). dereg blocks on this reaching zero,
+  // matching ibv_dereg_mr's guarantee that the NIC never touches the
+  // memory after dereg returns. NOT covered: the peer's fire-and-
+  // forget CMA read of a desc-tier WRITE/SEND source — revoking that
+  // buffer mid-flight can make the peer read stale bytes (it then
+  // errors or carries stale payload), but never corrupts local
+  // memory; a real HCA in the same race fails the op at its MTT.
   std::atomic<int> inflight{0};
   // Object-lifetime references: queued recvs (PostedRecv::mr) AND
   // pending ops (PendingOp::mr) hold the EmuMr alive so their
@@ -688,7 +693,12 @@ class EmuQp : public Qp {
       // step) until the pull has landed.
       bool ok = par_cma_reduce_from(peer_pid_, r.dst, u.src_va, u.len,
                                     r.dtype, r.red_op);
-      if (!ok) {
+      // Hold a SECOND inflight ref across the sender's pull (the
+      // DmaGuard's ref dies with this scope): the folded bytes must
+      // stay resident until OP_FB_WB_ACK confirms the pull landed —
+      // same scheme as read_srcs_. landing_begin re-validates; a
+      // revocation racing in here degrades to the error ack.
+      if (!ok || !eng_->landing_begin(r.mr)) {
         ack.status = TDR_WC_GENERAL_ERR;
         sent = send_frame(ack, nullptr, 0);
         push_wc({r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, u.len});
@@ -701,7 +711,7 @@ class EmuQp : public Qp {
       wb.aux = reinterpret_cast<uint64_t>(r.dst);
       {
         std::lock_guard<std::mutex> g(mu_);
-        fb_waiting_[u.seq] = {r.wr_id, u.len};
+        fb_waiting_[u.seq] = {r.wr_id, u.len, r.mr};
       }
       return send_frame(wb, nullptr, 0);
     }
@@ -1236,25 +1246,27 @@ class EmuQp : public Qp {
           break;
         }
         case OP_FB_WB_ACK: {
-          // The peer's pull finished (or failed): surface the
-          // deferred foldback-recv completion.
-          uint64_t wr_id = 0, len = 0;
+          // The peer's pull finished (or failed): release the folded
+          // buffer's inflight ref and surface the deferred
+          // foldback-recv completion.
+          FbWaiting w{};
           bool have = false;
           {
             std::lock_guard<std::mutex> g(mu_);
             auto it = fb_waiting_.find(h.seq);
             if (it != fb_waiting_.end()) {
-              wr_id = it->second.first;
-              len = it->second.second;
+              w = it->second;
               fb_waiting_.erase(it);
               have = true;
             }
           }
-          if (have)
-            push_wc({wr_id,
+          if (have) {
+            EmuEngine::dma_done(w.mr);
+            push_wc({w.wr_id,
                      h.status == TDR_WC_SUCCESS ? TDR_WC_SUCCESS
                                                 : TDR_WC_LOC_ACCESS_ERR,
-                     TDR_OP_RECV, len});
+                     TDR_OP_RECV, w.len});
+          }
           break;
         }
         case OP_WRITE_ACK:
@@ -1325,10 +1337,13 @@ class EmuQp : public Qp {
       release_recv(r);
     }
     recvs_.clear();
-    // Foldback recvs whose write-back pull was never acked flush too.
-    for (auto &kv : fb_waiting_)
-      cq_.push_back({kv.second.first, TDR_WC_FLUSH_ERR, TDR_OP_RECV,
-                     kv.second.second});
+    // Foldback recvs whose write-back pull was never acked flush too
+    // (dropping their folded-buffer refs so dereg doesn't spin).
+    for (auto &kv : fb_waiting_) {
+      cq_.push_back({kv.second.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV,
+                     kv.second.len});
+      EmuEngine::dma_done(kv.second.mr);
+    }
     fb_waiting_.clear();
     // READ sources whose pull was never acked: drop their refs so
     // dereg doesn't spin on a dead connection.
@@ -1366,8 +1381,14 @@ class EmuQp : public Qp {
   std::deque<tdr_wc> cq_;
   std::unordered_map<uint64_t, PendingOp> pending_;
   // Desc-tier foldback recvs folded but awaiting the sender's
-  // pull-ack (OP_FB_WB_ACK): seq → (wr_id, len).
-  std::unordered_map<uint64_t, std::pair<uint64_t, uint64_t>> fb_waiting_;
+  // pull-ack (OP_FB_WB_ACK); mr holds an inflight ref so the folded
+  // bytes stay resident across the pull.
+  struct FbWaiting {
+    uint64_t wr_id = 0;
+    uint64_t len = 0;
+    EmuMr *mr = nullptr;
+  };
+  std::unordered_map<uint64_t, FbWaiting> fb_waiting_;
   // Desc-tier READ sources holding an inflight ref until the
   // requester's OP_READ_PULLED ack: seq → MR.
   std::unordered_map<uint64_t, EmuMr *> read_srcs_;
