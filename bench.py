@@ -147,11 +147,10 @@ def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
     from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
     from rocnrdma_tpu.collectives.world import local_worlds
 
-    port = _free_port()
-
     n = nbytes // 4 // leaves
     out = {}
-    for mode, env in (("pipelined", "0"), ("serial", "1")):
+    try:
+      for mode, env in (("pipelined", "0"), ("serial", "1")):
         os.environ["TDR_NO_STAGE_PIPELINE"] = env
         worlds = local_worlds(2, _free_port())
         shims = [CrossSliceAllReduce(worlds[r]) for r in range(2)]
@@ -178,7 +177,8 @@ def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
             sh.close()
         for w in worlds:
             w.close()
-    os.environ.pop("TDR_NO_STAGE_PIPELINE", None)
+    finally:
+      os.environ.pop("TDR_NO_STAGE_PIPELINE", None)
     return out
 
 

@@ -162,6 +162,13 @@ void copy_nt(char *dst, const char *src, size_t len);
 // and src (streamed) — the one-pass kernel behind send_foldback when
 // both buffers are in this address space.
 void par_reduce2_local(void *dst, void *src, size_t n, int dt, int op);
+// Cross-process variant: fold peer bytes at `src` (pid's address
+// space) into dst, writing the folded result back to the peer — one
+// windowed pass. Returns false on CMA failure. The CALLER guarantees
+// the peer region stays resident (the foldback sender holds an
+// active inflight ref on its MR from post to completion).
+bool par_cma_reduce2(pid_t pid, void *dst, uint64_t src, size_t bytes,
+                     int dt, int op);
 
 // TCP helpers (bootstrap for both backends; data path for emu).
 int tcp_listen_accept(const char *bind_host, int port, std::string *err);

@@ -314,6 +314,46 @@ void par_reduce2_local(void *dst, void *src, size_t n, int dt, int op) {
   });
 }
 
+// Cross-process exchange fold: pull a window of peer bytes, fold it
+// into dst while writing the folded values back into the window, and
+// push the window back — one pass over dst, two kernel copies of the
+// (cache-resident) window.
+bool par_cma_reduce2(pid_t pid, void *dst, uint64_t src, size_t bytes,
+                     int dt, int op) {
+  size_t esz = dtype_size(dt);
+  if (esz == 0 || bytes % esz != 0) return false;
+  if (pid == kCmaSameProcess) {
+    par_reduce2_local(dst, reinterpret_cast<void *>(src), bytes / esz, dt,
+                      op);
+    return true;
+  }
+  std::atomic<bool> ok{true};
+  size_t grain = kGrain - kGrain % esz;
+  CopyPool::instance().parfor(bytes, grain, [&](size_t b, size_t e) {
+    char window[256 << 10];
+    const size_t step = sizeof(window) - sizeof(window) % esz;
+    char *d = static_cast<char *>(dst) + b;
+    uint64_t s = src + b;
+    size_t left = e - b;
+    while (left > 0) {
+      size_t chunk = left < step ? left : step;
+      if (!cma_copy_from(pid, window, s, chunk)) {
+        ok.store(false, std::memory_order_relaxed);
+        return;
+      }
+      reduce2_any(d, window, chunk / esz, dt, op);
+      if (!cma_copy_to(pid, s, window, chunk)) {
+        ok.store(false, std::memory_order_relaxed);
+        return;
+      }
+      d += chunk;
+      s += chunk;
+      left -= chunk;
+    }
+  });
+  return ok.load();
+}
+
 
 // dst[i] op= peer_mem[i]: same-process folds read the peer buffer in
 // place; cross-process slices stream through per-slice stack windows

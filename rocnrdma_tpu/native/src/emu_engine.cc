@@ -61,23 +61,17 @@ enum WireOp : uint8_t {
   // folds the payload into its matched recv_reduce buffer and returns
   // the folded result in place over the sender's source. Stream tier:
   // payload follows the FB frame and the folded bytes ride back on
-  // the ack (a copy independent of the receiver's buffer, so the
-  // receiver completes immediately). CMA tier: three frames — the
-  // receiver folds and offers the result (FB_WB carries its VA), the
-  // sender PULLS it under its own MR validation, then acks
-  // (FB_WB_ACK); the receiver's completion waits for that ack, so it
-  // cannot repurpose the folded buffer (mean-divide, next step)
-  // while the sender's pull is still in flight.
+  // the ack (a copy independent of the receiver's buffer). CMA tier:
+  // the receiver's ONE-PASS fused kernel (par_cma_reduce2) folds and
+  // writes the peer's memory directly, then the bare ack releases the
+  // sender — push-before-ack makes the ordering safe (the sender's
+  // bytes are final before either side completes), and the sender's
+  // pending op holds an ACTIVE inflight ref on its MR from post to
+  // completion, so revocation/dereg quiesce across the push instead
+  // of letting the owner reclaim the pages under it.
   OP_SEND_FB = 11,
   OP_SEND_FB_DESC = 12,
   OP_SEND_FB_ACK = 13,
-  OP_FB_WB = 14,
-  OP_FB_WB_ACK = 15,
-  // Desc-tier READ: the requester PULLS the bytes (its landing is
-  // validated on its own side) and then acknowledges, releasing the
-  // responder's source inflight ref — without the ack, dereg could
-  // return and the owner reclaim the pages mid-pull.
-  OP_READ_PULLED = 16,
 };
 
 #pragma pack(push, 1)
@@ -188,41 +182,43 @@ class EmuMr : public Mr {
   void *mapped = nullptr;  // dma-buf mmap base (owned), else null
   size_t maplen = 0;
   // In-flight accesses ("NIC" DMA in progress): every WRITE into this
-  // MR's memory (recv landings, READ-response landings, foldback
-  // write-back pulls) plus reads the protocol explicitly brackets
-  // with an ack (READ sources until OP_READ_PULLED, folded foldback
-  // buffers until OP_FB_WB_ACK). dereg blocks on this reaching zero,
-  // matching ibv_dereg_mr's guarantee that the NIC never touches the
-  // memory after dereg returns. NOT covered: the peer's fire-and-
-  // forget CMA read of a desc-tier WRITE/SEND source — revoking that
-  // buffer mid-flight can make the peer read stale bytes (it then
-  // errors or carries stale payload), but never corrupts local
-  // memory; a real HCA in the same race fails the op at its MTT.
+  // MR's memory (recv landings, READ-response landings) AND every
+  // pending op whose local buffer the peer may still touch (desc-tier
+  // WRITE/SEND/foldback sources the peer reads or writes back into,
+  // READ destinations the peer pushes into) — held from post to
+  // completion/flush. dereg and invalidate block on this reaching
+  // zero, matching ibv_dereg_mr's guarantee that the NIC never
+  // touches the memory after teardown returns. The wait is bounded in
+  // practice by the peer's progress threads (acks are generated by
+  // the transport, not by user polls) and, in wedged-collective error
+  // states, by the stall deadline after which connections close and
+  // the flush drops the refs; both waiters also carry a hard deadline
+  // (see quiesce_wait) as a backstop.
   std::atomic<int> inflight{0};
-  // Object-lifetime references: queued recvs (PostedRecv::mr) AND
-  // pending ops (PendingOp::mr) hold the EmuMr alive so their
-  // completion paths can re-validate through it. Unlike inflight
-  // (active DMA, bounded-time), these may never resolve (a recv that
-  // never matches, a foldback stashed at a dead peer) — dereg must
-  // NOT wait for them, so a dereg'd MR with live recv_refs parks in
-  // the engine graveyard instead of being freed.
+  // Object-lifetime references: queued recvs (PostedRecv::mr) hold
+  // the EmuMr alive so the landing path can re-validate through it.
+  // Unlike inflight, a queued recv may never match — dereg must NOT
+  // wait for these, so a dereg'd MR with live recv_refs parks in the
+  // engine graveyard instead of being freed.
   std::atomic<int> recv_refs{0};
-  // Revocation QUIESCES active copies: mark invalid first (no new
-  // landings start, no new posts accepted), then wait out in-flight
-  // DMA — the owner reclaims the pages only after free_callback
-  // returns, so an invalidate that returned mid-write would hand
+  // Revocation QUIESCES: mark invalid first (no new landings start,
+  // no new posts accepted), then wait out in-flight DMA and pending
+  // exposures — the owner reclaims the pages only after free_callback
+  // returns, so an invalidate that returned mid-access would hand
   // reclaimed memory to a still-running copy (the reference's
   // free_callback contract: KFD reclaims on callback return,
   // amdp2p.c:105-107, which is only safe because the IB teardown
-  // inside the callback quiesced the NIC first). The wait is bounded:
-  // inflight covers actual copies in progress, never
-  // waiting-for-the-peer state. The engine-mutex barrier between the
-  // store and the wait serializes against landing_begin's
-  // check-then-increment (held under that same mutex): any landing
-  // that read valid==true has raised inflight before the barrier
-  // returns; later ones observe valid==false. Defined out of line —
-  // EmuEngine is incomplete here.
+  // inside the callback quiesced the NIC first). The engine-mutex
+  // barrier between the store and the wait serializes against
+  // landing_begin's check-then-increment (held under that same
+  // mutex): any landing that read valid==true has raised inflight
+  // before the barrier returns; later ones observe valid==false.
+  // Defined out of line — EmuEngine is incomplete here.
   int invalidate() override;
+  // Wait for in-flight accesses to drain, with a hard deadline (the
+  // ring stall deadline + slack) as a backstop for doubly-wedged
+  // error states where no flush will ever run.
+  void quiesce_wait();
   ~EmuMr() override {
     if (mapped) munmap(mapped, maplen);
   }
@@ -299,9 +295,9 @@ class EmuEngine : public Engine {
       mrs_.erase(mr->rkey);  // no new resolves from here on
       cpu_base_.erase(mr->rkey);
     }
-    // Wait out in-flight "DMA" before freeing — ibv_dereg_mr semantics.
-    while (emr->inflight.load(std::memory_order_acquire) > 0)
-      std::this_thread::yield();
+    // Wait out in-flight "DMA" before freeing — ibv_dereg_mr
+    // semantics (deadline-backstopped; see EmuMr::quiesce_wait).
+    emr->quiesce_wait();
     // Queued recvs may still hold this MR (they check `valid` before
     // touching memory, but dereference the object to do so) — and may
     // never match, so waiting here could hang forever. Park the MR in
@@ -309,15 +305,23 @@ class EmuEngine : public Engine {
     // recv_refs drain (bounding the graveyard for long-lived engines
     // that cycle register→post→dereg), and engine close frees the rest.
     std::lock_guard<std::mutex> g(mu_);
+    auto parked = [](EmuMr *m) {
+      // recv_refs: queued recvs that may never match. inflight: a
+      // timed-out quiesce (wedged peer) — the pending op's ref will
+      // still be dropped at flush/completion, which must not touch a
+      // freed object. Either parks the MR in the graveyard.
+      return m->recv_refs.load(std::memory_order_acquire) > 0 ||
+             m->inflight.load(std::memory_order_acquire) > 0;
+    };
     for (auto it = graveyard_.begin(); it != graveyard_.end();) {
-      if ((*it)->recv_refs.load(std::memory_order_acquire) == 0) {
+      if (!parked(*it)) {
         delete *it;
         it = graveyard_.erase(it);
       } else {
         ++it;
       }
     }
-    if (emr->recv_refs.load(std::memory_order_acquire) > 0)
+    if (parked(emr))
       graveyard_.push_back(emr);
     else
       delete emr;
@@ -408,12 +412,11 @@ struct PendingOp {
   int opcode;     // TDR_OP_*
   char *dst;      // READ destination
   uint64_t len;
-  // Local MR whose memory this op's COMPLETION may write (READ
-  // destination, foldback write-back target). Holds a recv_ref
-  // (object lifetime) from post to completion/flush; the landing at
-  // ack time re-validates through it (landing_begin), so a
-  // revocation in flight fails the op instead of writing reclaimed
-  // memory.
+  // Local MR whose memory the peer may touch until this op completes
+  // (desc-tier source it reads or folds back into, READ destination
+  // it pushes into). Holds an ACTIVE inflight ref from post to
+  // completion/flush, so revocation/dereg quiesce across the access;
+  // ack-time landings additionally re-validate through it.
   EmuMr *mr = nullptr;
 };
 
@@ -441,11 +444,21 @@ struct PostedRecv {
   EmuMr *mr = nullptr;
 };
 
+void EmuMr::quiesce_wait() {
+  const char *env = getenv("TDR_RING_TIMEOUT_MS");
+  long long timeout_ms = env && *env ? atoll(env) : 30000;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms + 5000);
+  while (inflight.load(std::memory_order_acquire) > 0) {
+    if (std::chrono::steady_clock::now() >= deadline) return;
+    std::this_thread::yield();
+  }
+}
+
 int EmuMr::invalidate() {
   valid.store(false, std::memory_order_release);
   if (eng) eng->quiesce_barrier();
-  while (inflight.load(std::memory_order_acquire) > 0)
-    std::this_thread::yield();
+  quiesce_wait();
   return 0;
 }
 
@@ -469,7 +482,13 @@ class EmuQp : public Qp {
       set_error("post_write: invalid local MR range");
       return -1;
     }
-    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
+    // Active exposure ref (validity-checked): held by the pending op
+    // until completion/flush so revocation quiesces across the peer's
+    // access to this buffer.
+    if (!eng_->landing_begin(emr)) {
+      set_error("post_write: MR invalidated");
+      return -1;
+    }
     FrameHdr h{};
     h.op = cma_ ? OP_WRITE_DESC : OP_WRITE;
     h.rkey = rkey;
@@ -490,7 +509,13 @@ class EmuQp : public Qp {
       set_error("post_read: invalid local MR range");
       return -1;
     }
-    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
+    // Active exposure ref (validity-checked): held by the pending op
+    // until completion/flush so revocation quiesces across the peer's
+    // access to this buffer.
+    if (!eng_->landing_begin(emr)) {
+      set_error("post_read: MR invalidated");
+      return -1;
+    }
     FrameHdr h{};
     h.op = cma_ ? OP_READ_REQ_DESC : OP_READ_REQ;
     h.rkey = rkey;
@@ -509,7 +534,13 @@ class EmuQp : public Qp {
       set_error("post_send: invalid local MR range");
       return -1;
     }
-    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
+    // Active exposure ref (validity-checked): held by the pending op
+    // until completion/flush so revocation quiesces across the peer's
+    // access to this buffer.
+    if (!eng_->landing_begin(emr)) {
+      set_error("post_send: MR invalidated");
+      return -1;
+    }
     FrameHdr h{};
     h.op = cma_ ? OP_SEND_DESC : OP_SEND;
     h.len = len;
@@ -543,16 +574,22 @@ class EmuQp : public Qp {
       set_error("post_send_foldback: invalid local MR range");
       return -1;
     }
-    emr->recv_refs.fetch_add(1, std::memory_order_acq_rel);
+    // Active exposure ref (validity-checked): held by the pending op
+    // until completion/flush so revocation quiesces across the peer's
+    // access to this buffer.
+    if (!eng_->landing_begin(emr)) {
+      set_error("post_send_foldback: MR invalidated");
+      return -1;
+    }
     FrameHdr h{};
     h.op = cma_ ? OP_SEND_FB_DESC : OP_SEND_FB;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(src);
     // dst = src: the folded result lands back over the source region.
-    // Stream tier: the ack payload is read into it; CMA tier: PULLED
-    // from the receiver's folded buffer. Both landings re-validate
-    // the MR at copy time (the ack handler's landing_begin), so a
-    // revocation in flight fails the op instead of scribbling.
+    // Stream tier: the ack payload is read into it (landing
+    // re-validated at the ack handler); CMA tier: the receiver's
+    // fused kernel writes it directly before acking, made safe by the
+    // active inflight ref this post holds until completion.
     h.seq = new_pending(wr_id, TDR_OP_SEND, src, len, emr);
     bool ok = cma_ ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
     if (!ok) return fail_pending(h.seq);
@@ -685,35 +722,21 @@ class EmuQp : public Qp {
       return sent;
     }
     if (u.desc) {
-      // Fold the peer's bytes into OUR buffer (validated above). The
-      // write-back is a PULL by the sender — its write into its own
-      // source region runs under its own MR validation, not blind
-      // from here — and OUR completion waits for its FB_WB_ACK: the
-      // folded bytes must stay untouched (no mean-divide, no next
-      // step) until the pull has landed.
-      bool ok = par_cma_reduce_from(peer_pid_, r.dst, u.src_va, u.len,
-                                    r.dtype, r.red_op);
-      // Hold a SECOND inflight ref across the sender's pull (the
-      // DmaGuard's ref dies with this scope): the folded bytes must
-      // stay resident until OP_FB_WB_ACK confirms the pull landed —
-      // same scheme as read_srcs_. landing_begin re-validates; a
-      // revocation racing in here degrades to the error ack.
-      if (!ok || !eng_->landing_begin(r.mr)) {
-        ack.status = TDR_WC_GENERAL_ERR;
-        sent = send_frame(ack, nullptr, 0);
-        push_wc({r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, u.len});
-        return sent;
-      }
-      FrameHdr wb{};
-      wb.op = OP_FB_WB;
-      wb.seq = u.seq;
-      wb.len = u.len;
-      wb.aux = reinterpret_cast<uint64_t>(r.dst);
-      {
-        std::lock_guard<std::mutex> g(mu_);
-        fb_waiting_[u.seq] = {r.wr_id, u.len, r.mr};
-      }
-      return send_frame(wb, nullptr, 0);
+      // ONE fused pass: fold the peer's bytes into OUR buffer while
+      // writing the folded result back into the peer's source — safe
+      // because the sender's pending op holds an active inflight ref
+      // on that source from post until our ack completes it, so
+      // revocation on its side quiesces rather than reclaiming the
+      // pages under this write. Push-before-ack also makes ordering
+      // safe: by the time either side completes, both buffers are
+      // final.
+      bool ok = par_cma_reduce2(peer_pid_, r.dst, u.src_va, u.len, r.dtype,
+                                r.red_op);
+      ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
+      sent = send_frame(ack, nullptr, 0);
+      push_wc({r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
+               TDR_OP_RECV, u.len});
+      return sent;
     }
     // Stream tier: fold the payload in place (it ends up holding the
     // folded values) and return it on the ack. Parallel fold — MB-sized
@@ -820,7 +843,7 @@ class EmuQp : public Qp {
     probe_val_ = kHelloMagic ^ reinterpret_cast<uint64_t>(this);
     Hello mine{};
     mine.magic = kHelloMagic;
-    mine.version = 4;
+    mine.version = 5;
     mine.pid = getpid();
     mine.uid = getuid();
     mine.features = local_features();
@@ -874,9 +897,9 @@ class EmuQp : public Qp {
     cma_ = my_ok && peer_res.cma_ok;
   }
 
-  // Caller already holds a recv_ref on `mr` (object-lifetime, see
-  // EmuMr); ownership passes to the pending entry and is dropped at
-  // completion, failure, or flush.
+  // Caller already holds an ACTIVE inflight ref on `mr`
+  // (landing_begin at the post path); ownership passes to the pending
+  // entry and is dropped at completion, failure, or flush.
   uint64_t new_pending(uint64_t wr_id, int opcode, char *dst, uint64_t len,
                        EmuMr *mr) {
     std::lock_guard<std::mutex> g(mu_);
@@ -885,9 +908,7 @@ class EmuQp : public Qp {
     return seq;
   }
 
-  static void release_pending_mr(EmuMr *mr) {
-    if (mr) mr->recv_refs.fetch_sub(1, std::memory_order_acq_rel);
-  }
+  static void release_pending_mr(EmuMr *mr) { EmuEngine::dma_done(mr); }
 
   int fail_pending(uint64_t seq) {
     std::lock_guard<std::mutex> g(mu_);
@@ -1132,33 +1153,17 @@ class EmuQp : public Qp {
           resp.seq = h.seq;
           resp.len = 0;  // bytes move via CMA, none follow on the wire
           if (src) {
-            // The REQUESTER pulls the bytes (its landing into its own
-            // MR is validated there); pushing into the requester's
-            // memory from here would write a buffer whose validity
-            // only the requester can check. The source's inflight ref
-            // is held until the requester's OP_READ_PULLED ack, so
-            // dereg/invalidate quiesce across the pull.
-            resp.status = TDR_WC_SUCCESS;
-            resp.aux = reinterpret_cast<uint64_t>(src);
-            std::lock_guard<std::mutex> g(mu_);
-            read_srcs_[h.seq] = tmr;
+            // Push into the requester's destination: safe because its
+            // pending op holds an active inflight ref on that MR from
+            // post to completion, so its revocation quiesces across
+            // this write; our source is bracketed by resolve/dma_done.
+            bool ok = par_cma_copy_to(peer_pid_, h.aux, src, h.len);
+            EmuEngine::dma_done(tmr);
+            resp.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
           } else {
             resp.status = TDR_WC_REM_ACCESS_ERR;
           }
           if (!send_frame(resp, nullptr, 0)) goto out;
-          break;
-        }
-        case OP_READ_PULLED: {
-          EmuMr *tmr = nullptr;
-          {
-            std::lock_guard<std::mutex> g(mu_);
-            auto it = read_srcs_.find(h.seq);
-            if (it != read_srcs_.end()) {
-              tmr = it->second;
-              read_srcs_.erase(it);
-            }
-          }
-          EmuEngine::dma_done(tmr);
           break;
         }
         case OP_SEND_DESC: {
@@ -1177,11 +1182,11 @@ class EmuQp : public Qp {
         }
         case OP_SEND_FB_ACK: {
           // Land the folded result over the pending send's source
-          // region (the in-place final): stream tier carries it as
-          // the ack payload; CMA tier PULLS it from the receiver's
-          // folded buffer (ack.aux). Either way the landing
-          // re-validates the MR first — a revocation between post
-          // and ack must fail the op, never write reclaimed memory.
+          // region (the in-place final): the stream tier carries it
+          // as the ack payload, landed here under MR re-validation;
+          // in the CMA tier the receiver already wrote it before
+          // acking (guarded by this op's held inflight ref), so the
+          // ack is bare and only completes the pending.
           char *dst = nullptr;
           uint64_t want = 0;
           EmuMr *pmr = nullptr;
@@ -1208,65 +1213,6 @@ class EmuQp : public Qp {
             }
           }
           complete_pending(h.seq, st, nullptr, 0);
-          break;
-        }
-        case OP_FB_WB: {
-          // Desc-tier foldback write-back offer: PULL the folded
-          // bytes into our pending send's source region — a landing
-          // write, re-validated through the MR — then ack so the
-          // peer's completion (and its freedom to reuse the folded
-          // buffer) unblocks.
-          if (!cma_) goto out;
-          char *dst = nullptr;
-          uint64_t want = 0;
-          EmuMr *pmr = nullptr;
-          {
-            std::lock_guard<std::mutex> g(mu_);
-            auto it = pending_.find(h.seq);
-            if (it != pending_.end()) {
-              dst = it->second.dst;
-              want = it->second.len;
-              pmr = it->second.mr;
-            }
-          }
-          fault_landing_delay();
-          uint8_t st = TDR_WC_LOC_ACCESS_ERR;
-          if (dst && h.len == want && eng_->landing_begin(pmr)) {
-            if (par_cma_copy_from(peer_pid_, dst, h.aux, want))
-              st = TDR_WC_SUCCESS;
-            EmuEngine::dma_done(pmr);
-          }
-          FrameHdr ack{};
-          ack.op = OP_FB_WB_ACK;
-          ack.seq = h.seq;
-          ack.status = st;
-          bool sent = send_frame(ack, nullptr, 0);
-          complete_pending(h.seq, st, nullptr, 0);
-          if (!sent) goto out;
-          break;
-        }
-        case OP_FB_WB_ACK: {
-          // The peer's pull finished (or failed): release the folded
-          // buffer's inflight ref and surface the deferred
-          // foldback-recv completion.
-          FbWaiting w{};
-          bool have = false;
-          {
-            std::lock_guard<std::mutex> g(mu_);
-            auto it = fb_waiting_.find(h.seq);
-            if (it != fb_waiting_.end()) {
-              w = it->second;
-              fb_waiting_.erase(it);
-              have = true;
-            }
-          }
-          if (have) {
-            EmuEngine::dma_done(w.mr);
-            push_wc({w.wr_id,
-                     h.status == TDR_WC_SUCCESS ? TDR_WC_SUCCESS
-                                                : TDR_WC_LOC_ACCESS_ERR,
-                     TDR_OP_RECV, w.len});
-          }
           break;
         }
         case OP_WRITE_ACK:
@@ -1298,20 +1244,6 @@ class EmuQp : public Qp {
               if (!drain(h.len)) goto out;
               st = TDR_WC_LOC_ACCESS_ERR;
             }
-          } else if (st == TDR_WC_SUCCESS && cma_ && h.aux) {
-            // Desc tier: pull the bytes from the responder's source
-            // (read-only peer access; the local landing is
-            // validated), then release the responder's source ref.
-            bool ok = false;
-            if (dst && eng_->landing_begin(pmr)) {
-              ok = par_cma_copy_from(peer_pid_, dst, h.aux, want);
-              EmuEngine::dma_done(pmr);
-            }
-            if (!ok) st = TDR_WC_LOC_ACCESS_ERR;
-            FrameHdr pulled{};
-            pulled.op = OP_READ_PULLED;
-            pulled.seq = h.seq;
-            if (!send_frame(pulled, nullptr, 0)) goto out;
           }
           complete_pending(h.seq, st, nullptr, 0);
           break;
@@ -1337,18 +1269,6 @@ class EmuQp : public Qp {
       release_recv(r);
     }
     recvs_.clear();
-    // Foldback recvs whose write-back pull was never acked flush too
-    // (dropping their folded-buffer refs so dereg doesn't spin).
-    for (auto &kv : fb_waiting_) {
-      cq_.push_back({kv.second.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV,
-                     kv.second.len});
-      EmuEngine::dma_done(kv.second.mr);
-    }
-    fb_waiting_.clear();
-    // READ sources whose pull was never acked: drop their refs so
-    // dereg doesn't spin on a dead connection.
-    for (auto &kv : read_srcs_) EmuEngine::dma_done(kv.second);
-    read_srcs_.clear();
     cv_.notify_all();
   }
 
@@ -1380,18 +1300,6 @@ class EmuQp : public Qp {
   std::condition_variable cv_;
   std::deque<tdr_wc> cq_;
   std::unordered_map<uint64_t, PendingOp> pending_;
-  // Desc-tier foldback recvs folded but awaiting the sender's
-  // pull-ack (OP_FB_WB_ACK); mr holds an inflight ref so the folded
-  // bytes stay resident across the pull.
-  struct FbWaiting {
-    uint64_t wr_id = 0;
-    uint64_t len = 0;
-    EmuMr *mr = nullptr;
-  };
-  std::unordered_map<uint64_t, FbWaiting> fb_waiting_;
-  // Desc-tier READ sources holding an inflight ref until the
-  // requester's OP_READ_PULLED ack: seq → MR.
-  std::unordered_map<uint64_t, EmuMr *> read_srcs_;
   std::deque<PostedRecv> recvs_;
   std::deque<Unexpected> unexpected_;
   uint64_t next_seq_ = 1;
