@@ -504,7 +504,17 @@ class RegistrationManager:
                 # Legacy path: register the bus-address range directly
                 # (the sg entries are flat in the fake exporter, as in
                 # the IOMMU-off world the reference assumes,
-                # amdp2p.c:222-240).
+                # amdp2p.c:222-240). Exporters whose VAs are
+                # bookkeeping-only (synthetic ranges when PJRT hides
+                # pointers) veto this — a garbage address must never
+                # become a live MR the ring would DMA against.
+                registrable = getattr(self.exporter, "direct_registrable",
+                                      None)
+                if registrable is not None and not registrable(va, size):
+                    raise HbmError(
+                        f"[{va:#x},+{size}) has no host-visible memory "
+                        "(synthetic VA): dma-buf export is required for "
+                        "a data-path registration")
                 mr = self.engine.reg_mr((va, size))
         except BaseException:
             # Unwind the pin — a failed registration must not leak

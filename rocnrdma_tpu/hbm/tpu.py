@@ -39,7 +39,8 @@ TPU_PAGE_SIZE = DEFAULT_PAGE_SIZE
 
 
 _synthetic_lock = threading.Lock()
-_synthetic_next = [1 << 44]  # far from any real mapping
+_SYNTHETIC_BASE = 1 << 44  # far from any real mapping
+_synthetic_next = [_SYNTHETIC_BASE]
 
 
 def _synthetic_va(nbytes: int) -> int:
@@ -52,6 +53,13 @@ def _synthetic_va(nbytes: int) -> int:
         _synthetic_next[0] += (nbytes + TPU_PAGE_SIZE - 1) // TPU_PAGE_SIZE * \
             TPU_PAGE_SIZE + TPU_PAGE_SIZE
         return va
+
+
+def is_synthetic_va(va: int) -> bool:
+    """Whether ``va`` came from the synthetic allocator (no real memory
+    behind it — bookkeeping only, must never reach a data path)."""
+    with _synthetic_lock:
+        return _SYNTHETIC_BASE <= va < _synthetic_next[0]
 
 
 def buffer_pointer(arr) -> int:
@@ -271,6 +279,16 @@ class TPUExporter(MemoryExporter):
         raise HbmError(
             "TPU HBM dma-buf export unavailable in this libtpu build; "
             "use the staged path or the tpup2p kernel shim")
+
+    def direct_registrable(self, va: int, size: int) -> bool:
+        """Synthetic-VA ranges keep the pin LIFECYCLE testable when the
+        PJRT plugin hides raw pointers, but there is no memory behind
+        them — a legacy (non-dma-buf) MR over one would hand the ring
+        a garbage address. The registration manager consults this
+        before its direct reg_mr fallback and fails such ranges
+        loudly instead."""
+        del size
+        return not is_synthetic_va(va)
 
     def live_pins(self) -> int:
         with self._lock:

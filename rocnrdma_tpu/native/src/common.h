@@ -123,6 +123,11 @@ uint32_t local_features();
 // for all TDR_* opt-out knobs.
 bool env_set(const char *name);
 
+// The ring stall deadline (TDR_RING_TIMEOUT_MS, clamped >= 100ms,
+// default 30s) — shared so the engines' quiesce backstops cannot
+// undercut the deadline they are meant to exceed.
+int ring_timeout_ms();
+
 // Element size for a TDR_DT_*; 0 for unknown.
 size_t dtype_size(int dt);
 // dst[i] op= src[i] for n elements of dtype dt (bf16 accumulates in

@@ -193,7 +193,15 @@ class EmuMr : public Mr {
   // the transport, not by user polls) and, in wedged-collective error
   // states, by the stall deadline after which connections close and
   // the flush drops the refs; both waiters also carry a hard deadline
-  // (see quiesce_wait) as a backstop.
+  // (see quiesce_wait) as a backstop. KNOWN RESIDUAL of the emulation
+  // model (present in every revision): after a connection dies, the
+  // flush drops pending refs while the PEER process may still be
+  // mid-CMA-write into this buffer — the emulated "HCA" is split
+  // across processes, so teardown here cannot stop the other side's
+  // copy engine the way a real QP error state stops the one shared
+  // HCA. The window requires connection loss + immediate reclamation
+  // + a peer mid-write; closing it fully needs per-write completion
+  // handshakes (measured ~30% off the fused exchange).
   std::atomic<int> inflight{0};
   // Object-lifetime references: queued recvs (PostedRecv::mr) hold
   // the EmuMr alive so the landing path can re-validate through it.
@@ -217,8 +225,9 @@ class EmuMr : public Mr {
   int invalidate() override;
   // Wait for in-flight accesses to drain, with a hard deadline (the
   // ring stall deadline + slack) as a backstop for doubly-wedged
-  // error states where no flush will ever run.
-  void quiesce_wait();
+  // error states where no flush will ever run. Returns false on
+  // timeout (the guarantee is degraded; callers surface it).
+  bool quiesce_wait();
   ~EmuMr() override {
     if (mapped) munmap(mapped, maplen);
   }
@@ -444,21 +453,28 @@ struct PostedRecv {
   EmuMr *mr = nullptr;
 };
 
-void EmuMr::quiesce_wait() {
-  const char *env = getenv("TDR_RING_TIMEOUT_MS");
-  long long timeout_ms = env && *env ? atoll(env) : 30000;
+bool EmuMr::quiesce_wait() {
   auto deadline = std::chrono::steady_clock::now() +
-                  std::chrono::milliseconds(timeout_ms + 5000);
+                  std::chrono::milliseconds(ring_timeout_ms() + 5000);
   while (inflight.load(std::memory_order_acquire) > 0) {
-    if (std::chrono::steady_clock::now() >= deadline) return;
+    if (std::chrono::steady_clock::now() >= deadline) return false;
     std::this_thread::yield();
   }
+  return true;
 }
 
 int EmuMr::invalidate() {
   valid.store(false, std::memory_order_release);
   if (eng) eng->quiesce_barrier();
-  quiesce_wait();
+  if (!quiesce_wait()) {
+    // The collective is fatally wedged AND its stall deadline did not
+    // flush the refs — the quiesce guarantee is degraded: report it
+    // instead of silently handing back pages that may still see a
+    // late write.
+    set_error("mr_invalidate: quiesce timed out with DMA still in "
+              "flight (wedged peer?)");
+    return -1;
+  }
   return 0;
 }
 

@@ -51,20 +51,9 @@ size_t ring_chunk_bytes() {
   return kDefaultChunk;
 }
 
-// Stall deadline for a blocking drain (a peer that stopped sending —
-// crashed rank, revoked buffer — surfaces as this timeout). Tunable so
-// failure tests don't wait the production default.
-int ring_timeout_ms() {
-  const char *env = getenv("TDR_RING_TIMEOUT_MS");
-  if (env && *env) {
-    long long v = atoll(env);
-    if (v >= 100) return static_cast<int>(v);
-  }
-  return 30000;
-}
-
 using tdr::dtype_size;
 using tdr::reduce_any;
+using tdr::ring_timeout_ms;
 
 // wr_id tags for the pipeline: high 16 bits the kind, low bits the
 // chunk index, so one poll loop can route recv completions (in posted

@@ -26,6 +26,15 @@ bool env_set(const char *name) {
   return env && *env && *env != '0';
 }
 
+int ring_timeout_ms() {
+  const char *env = getenv("TDR_RING_TIMEOUT_MS");
+  if (env && *env) {
+    long long v = atoll(env);
+    if (v >= 100) return static_cast<int>(v);
+  }
+  return 30000;
+}
+
 uint32_t local_features() {
   uint32_t f = 0;
   if (!env_set("TDR_NO_FOLDBACK") && !env_set("TDR_NO_FUSED2"))

@@ -173,6 +173,30 @@ def test_stale_overlapping_adoption_does_not_shadow():
     exp2.put_pages(pin)
 
 
+def test_synthetic_va_never_reaches_the_ring():
+    """When PJRT hides buffer pointers, adopted regions get synthetic
+    VAs — bookkeeping that keeps the pin lifecycle testable. A
+    DATA-PATH registration over one (which would hand the ring a
+    garbage address via the legacy reg_mr fallback) must fail loudly
+    instead of composing silently."""
+    from rocnrdma_tpu.hbm import tpu as tpu_mod
+    from rocnrdma_tpu.hbm.registry import HbmError, RegistrationManager
+    from rocnrdma_tpu.transport.engine import Engine
+
+    exporter = TPUExporter()
+    va = tpu_mod._synthetic_va(4096)
+    assert tpu_mod.is_synthetic_va(va)
+    exporter.adopt_region(va, 4096)
+    e = Engine("emu")
+    mgr = RegistrationManager(e, exporter)
+    with pytest.raises(HbmError, match="synthetic"):
+        mgr.register(va, 4096)
+    # The failed registration must not leak a pin.
+    assert exporter.live_pins() == 0
+    mgr.close()
+    e.close()
+
+
 def test_schedule_mismatch_fails_fast():
     """Ranks calling with different layouts (sizes/residency) get an
     immediate TransportError from the schedule-digest handshake — not
