@@ -120,11 +120,18 @@ def test_ring_schedules_agree(world):
         {"TDR_NO_FUSED2": "1", "TDR_NO_WAVEFRONT": ""}, port + 10,
         world=world)
     variants = [generic, wave]
-    if world == 2:  # FusedTwo/foldback only engage at world == 2
+    if world == 2:  # FusedTwo engages only at world == 2
         variants.append(_ring_allreduce_result(
             {"TDR_NO_FUSED2": "", "TDR_NO_FOLDBACK": "1",
              "TDR_NO_WAVEFRONT": "1"}, port + 20, world=world))
         variants.append(_ring_allreduce_result({}, port + 30, world=world))
+    else:
+        # Wavefront with last-RS-step foldback (the last all-gather
+        # step replaced by the write-back), both transport tiers.
+        variants.append(_ring_allreduce_result(
+            {"TDR_NO_WAVE_FB": "1"}, port + 20, world=world))
+        variants.append(_ring_allreduce_result(
+            {"TDR_NO_CMA": "1"}, port + 30, world=world))
     want = None
     for bufs in variants:
         for b in bufs[1:]:
