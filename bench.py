@@ -268,6 +268,28 @@ print("TPUBENCH " + json.dumps(out))
 """
 
 
+def _fold_banked_tpu(out):
+    """Attach results banked by tools/tpu_chase.py / tools/tpu_extra.py
+    (the tunnel comes and goes; whatever it answered earlier this round
+    is still evidence), labeled with their capture time so "measured
+    earlier this round" is distinguishable from both "live" and "never
+    measured". Also counts the attempts log."""
+    for key, fname in (("tpu_banked", "TPU_RESULTS_r04.json"),
+                       ("tpu_banked_extra", "TPU_RESULTS_r04_extra.json")):
+        path = os.path.join(REPO, fname)
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    out[key] = json.load(f)
+            except Exception as e:  # noqa: BLE001
+                out[key] = f"unreadable: {e}"
+    attempts = os.path.join(REPO, "TPU_ATTEMPTS_r04.jsonl")
+    if os.path.exists(attempts):
+        with open(attempts) as f:
+            out["tpu_attempts"] = sum(1 for _ in f)
+    return out
+
+
 def bench_tpu_details(probe_timeout_s=120, bench_timeout_s=600):
     """TPU sub-benches with reachability RECORDED. The tunnel in this
     environment can hang for minutes; probe cheaply (with one retry)
@@ -294,27 +316,15 @@ def bench_tpu_details(probe_timeout_s=120, bench_timeout_s=600):
         devs, err2 = probe()  # the tunnel is flaky; one retry
         if devs is None:
             out = {"tpu": f"unreachable: {err} / retry: {err2}"}
-            # The tunnel comes and goes; tools/tpu_chase.py banks full
-            # results whenever it answers. Fold them in, labeled with
-            # their capture time — "measured earlier this round" is
-            # distinguishable from both "live" and "never measured".
-            banked = os.path.join(REPO, "TPU_RESULTS_r04.json")
-            attempts = os.path.join(REPO, "TPU_ATTEMPTS_r04.jsonl")
-            if os.path.exists(banked):
-                try:
-                    with open(banked) as f:
-                        out["tpu_banked"] = json.load(f)
-                    out["tpu"] += (" (banked results from "
-                                   f"{out['tpu_banked'].get('ts')} attached)")
-                except Exception as e:  # noqa: BLE001
-                    out["tpu_banked"] = f"unreadable: {e}"
-            if os.path.exists(attempts):
-                with open(attempts) as f:
-                    out["tpu_attempts"] = sum(1 for _ in f)
+            _fold_banked_tpu(out)
+            if isinstance(out.get("tpu_banked"), dict):
+                out["tpu"] += (" (banked results from "
+                               f"{out['tpu_banked'].get('ts')} attached)")
             return out
     accel = [d for d in devs if d["platform"] != "cpu"]
     if not accel:
-        return {"tpu": f"no accelerator devices (saw {devs})"}
+        return _fold_banked_tpu(
+            {"tpu": f"no accelerator devices (saw {devs})"})
 
     try:
         proc = subprocess.run(
@@ -337,14 +347,16 @@ def bench_tpu_details(probe_timeout_s=120, bench_timeout_s=600):
                     out["chip_peak_bf16_TFLOPs"] = peak
                     out["llama3_1b_fwd_MFU"] = round(
                         out["llama3_1b_fwd_TFLOPs"] / peak, 4)
-                return out
-        return {"tpu": "bench failed: " +
-                (proc.stderr or "no output").strip()[-300:]}
+                return _fold_banked_tpu(out)
+        return _fold_banked_tpu({"tpu": "bench failed: " +
+                                (proc.stderr or "no output").strip()[-300:]})
     except subprocess.TimeoutExpired:
-        return {"tpu": f"bench timed out after {bench_timeout_s}s "
-                       "(probe was reachable)"}
+        return _fold_banked_tpu(
+            {"tpu": f"bench timed out after {bench_timeout_s}s "
+                    "(probe was reachable)"})
     except Exception as e:  # noqa: BLE001
-        return {"tpu": f"bench error: {type(e).__name__}: {e}"}
+        return _fold_banked_tpu(
+            {"tpu": f"bench error: {type(e).__name__}: {e}"})
 
 
 def main():

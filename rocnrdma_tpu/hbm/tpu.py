@@ -17,6 +17,16 @@ reference (SURVEY.md §2 component 7), rebuilt for XLA's buffer model:
   callers fall back to the host-staged path — with every staged byte
   accounted (collectives.staging) so the "zero host staging" target of
   BASELINE.md config 3 is measurable the day the export lands.
+
+Hardware evidence for the constraint (round 4, TPU_RESULTS_r04.json,
+captured on the live "TPU v5 lite" chip 2026-07-30): both HBM
+introspection routes this exporter could use are refused by the PJRT
+plugin — ``unsafe_buffer_pointer`` → ``UNIMPLEMENTED:
+unsafe_buffer_pointer is unsupported on axon-PJRT; use IFRT`` and
+``__dlpack__`` → ``UNIMPLEMENTED: PJRT_Buffer_IncreaseExternalReference
+Count is not implemented``. The L2 gap is the platform's, not this
+layer's; on CPU-addressable jax.Arrays (where pointers ARE exposed)
+the zero-copy binding below engages end to end.
 """
 
 from __future__ import annotations

@@ -6,11 +6,15 @@ in HBM), f32 accumulation, bf16-friendly I/O. The kv-block loop is the
 innermost grid dimension so the running max / denominator / accumulator
 live in VMEM scratch across it (the canonical Pallas flash pattern).
 
-Training defaults to the XLA reference path: its backward is
-XLA-fused and correct today; the Pallas forward is wired through
-``jax.custom_vjp`` with a rematerializing XLA backward so gradients
-work either way. A hand-written backward kernel is a later-round
-optimization.
+Dispatch: the model flags default to auto — on TPU backends the Pallas
+forward IS the compute path (single-chip benched live: see
+TPU_RESULTS_r04_extra.json); elsewhere the XLA reference runs. The
+Pallas forward is wired through ``jax.custom_vjp`` with a
+rematerializing XLA backward so gradients work either way; a
+hand-written backward kernel is a later-round optimization. Under a
+multi-device pjit mesh the Trainer pins auto to the XLA path — the
+kernel has no GSPMD partitioning rule yet (shard_map wrapping is the
+planned fix), so GSPMD would replicate its operands.
 """
 
 from __future__ import annotations

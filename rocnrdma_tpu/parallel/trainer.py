@@ -48,10 +48,24 @@ class Trainer:
         cross_slice_sync: Optional[Callable[[Any], Any]] = None,
         devices=None,
         seed: int = 0,
+        **model_overrides,
     ):
-        self.model = make_model(config)
+        self.model = make_model(config, **model_overrides)
         self.cfg = self.model.cfg
         self.mesh = make_mesh(mesh_shape or {"dp": 1, "tp": 1}, devices)
+        if self.mesh.devices.size > 1:
+            # The Pallas kernels have no GSPMD partitioning rule yet:
+            # under a multi-device mesh GSPMD would replicate their
+            # operands (all-gathering tp-sharded activations). Pin the
+            # auto flags to the XLA path here — it shards cleanly —
+            # and leave explicit True to callers who shard_map it
+            # themselves. Single-device meshes keep Pallas-on-TPU.
+            pins = {f: False for f in ("use_pallas_attention",
+                                       "use_pallas_rmsnorm")
+                    if getattr(self.cfg, f) is None}
+            if pins:
+                self.model = make_model(self.cfg, **pins)
+                self.cfg = self.model.cfg
         self.tx = optax.adamw(learning_rate, weight_decay=weight_decay)
         self.cross_slice_sync = cross_slice_sync
 

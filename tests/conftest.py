@@ -33,6 +33,15 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Ring stall deadline for the suite. The default (30 s) is sized for
+# production fail-fast, but this 1-vCPU CI box can legitimately exceed
+# it when the suite runs concurrently with other load: measured round 4,
+# tests/test_jax_zero_copy.py was 1 failure ("ring(fused2): poll
+# timeout") in 12 runs racing bench.py, and 20/20 green unloaded.
+# Tests that assert the deadline semantics set their own tight value
+# via monkeypatch (tests/test_zero_copy.py).
+os.environ.setdefault("TDR_RING_TIMEOUT_MS", "120000")
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Follow-up TPU measurements, run while the flaky tunnel is alive.
+
+tools/tpu_chase.py banks the first successful core bench into
+TPU_RESULTS_r04.json; this script opportunistically deepens it:
+
+- ``entry()`` compile check with the production defaults (Pallas auto
+  → ON for the TPU backend) — proves the driver's single-chip gate
+  passes with the fused kernels as the compute path;
+- Llama-3-1B training step (fwd+bwd+adamw) tokens/s and model-FLOPs
+  utilisation, XLA vs Pallas forward;
+- incremental-decode throughput (the generate() KV-cache path);
+- op-level Pallas-vs-XLA timing + on-device parity for rmsnorm and
+  flash attention at Llama-3-1B shapes.
+
+Results append one line to TPU_ATTEMPTS_r04.jsonl and, on success,
+write TPU_RESULTS_r04_extra.json; bench.py folds both banked files
+into its output.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ATTEMPTS = os.path.join(REPO, "TPU_ATTEMPTS_r04.jsonl")
+RESULTS = os.path.join(REPO, "TPU_RESULTS_r04_extra.json")
+
+BENCH = r"""
+import functools, json, time, sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax, jax.numpy as jnp
+
+out = {"ts": time.strftime("%%Y-%%m-%%dT%%H:%%M:%%SZ", time.gmtime())}
+devs = [d for d in jax.devices() if d.platform != "cpu"]
+dev = devs[0]
+out["device_kind"] = getattr(dev, "device_kind", "?")
+print("STEP devices", flush=True)
+# Partial-result checkpoints: the tunnel (or an OOM in a later step)
+# can kill the run — emit the accumulated dict after every section so
+# the harness banks whatever completed.
+def part():
+    print("TPUPART " + json.dumps(out), flush=True)
+
+# --- entry() with production defaults (Pallas auto -> ON on TPU) ----
+import __graft_entry__ as ge
+fn, args = ge.entry()
+jfn = jax.jit(fn)
+r = jfn(*args)
+jax.tree_util.tree_map(lambda a: a.block_until_ready(), r)
+out["entry_auto_pallas_compiles"] = True
+print("STEP entry", flush=True)
+part()
+
+# --- op-level parity + timing at Llama-3-1B shapes ------------------
+from rocnrdma_tpu.ops.rmsnorm import rmsnorm, rmsnorm_reference
+from rocnrdma_tpu.ops.attention import attention_reference, flash_attention
+
+def timeit(f, *a, reps=10):
+    r = f(*a); jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(*a)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / reps, r
+
+key = jax.random.PRNGKey(0)
+x = jax.random.normal(key, (8, 2048, 2048), jnp.bfloat16)
+w = jnp.ones((2048,), jnp.float32)
+f_p = jax.jit(lambda x, w: rmsnorm(x, w, use_pallas=True))
+f_r = jax.jit(lambda x, w: rmsnorm_reference(x, w))
+tp, rp = timeit(f_p, x, w)
+tr, rr = timeit(f_r, x, w)
+out["rmsnorm_b8s2048d2048_us"] = {"pallas": round(tp * 1e6, 1),
+                                  "xla": round(tr * 1e6, 1)}
+out["rmsnorm_parity_maxerr"] = float(jnp.max(jnp.abs(
+    rp.astype(jnp.float32) - rr.astype(jnp.float32))))
+print("STEP rmsnorm", flush=True)
+part()
+
+kq, kk, kv = jax.random.split(key, 3)
+q = jax.random.normal(kq, (1, 16, 2048, 128), jnp.bfloat16)
+k = jax.random.normal(kk, (1, 8, 2048, 128), jnp.bfloat16)
+v = jax.random.normal(kv, (1, 8, 2048, 128), jnp.bfloat16)
+a_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
+a_r = jax.jit(lambda q, k, v: attention_reference(q, k, v, True))
+tp, rp = timeit(a_p, q, k, v)
+tr, rr = timeit(a_r, q, k, v)
+out["attn_h16kv8s2048d128_us"] = {"pallas": round(tp * 1e6, 1),
+                                  "xla": round(tr * 1e6, 1)}
+out["attn_parity_maxerr"] = float(jnp.max(jnp.abs(
+    rp.astype(jnp.float32) - rr.astype(jnp.float32))))
+print("STEP attention", flush=True)
+part()
+
+# --- training step (fwd+bwd+adamw), XLA vs Pallas forward -----------
+# Free every device array the earlier sections left alive (entry()'s
+# 1B params alone are ~1.8 GiB) — the 16 GiB chip needs the room.
+import gc
+del fn, args, jfn, r, rp, rr, x, w, q, k, v, f_p, f_r, a_p, a_r
+gc.collect()
+
+import optax
+from rocnrdma_tpu.models.llama import (
+    make_model, init_params, cross_entropy_loss)
+
+V5E_PEAK_BF16_TFLOPS = 197.0
+seq, batch = 2048, 2
+tokens = jnp.ones((batch, seq + 1), dtype=jnp.int32)
+
+# remat=True: without it the stored S^2 softmax activations of 16
+# layers (~1 GiB/layer f32 at batch 4) blow the 16 GiB chip — the
+# r04 first attempt OOMed exactly there.
+for label, overrides in (("xla", {"use_pallas_attention": False,
+                                  "use_pallas_rmsnorm": False}),
+                         ("pallas", {})):
+    model = make_model("llama3-1b", remat=True, **overrides)
+    params = init_params(model, jax.random.PRNGKey(0))
+    tx = optax.adamw(1e-4)
+    opt = tx.init(params)
+
+    def loss_fn(p, t):
+        return cross_entropy_loss(model.apply(p, t[:, :-1]), t[:, 1:])
+
+    # Donate params + opt state: without donation XLA double-buffers
+    # ~7 GiB of state across the update and the step OOMs.
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, o, t):
+        l, g = jax.value_and_grad(loss_fn)(p, t)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, l
+
+    p2, o2, l = step(params, opt, tokens)
+    del params, opt
+    jax.block_until_ready(l)
+    t0 = time.perf_counter(); reps = 3
+    for _ in range(reps):
+        p2, o2, l = step(p2, o2, tokens)
+    jax.block_until_ready(l)
+    dt = (time.perf_counter() - t0) / reps
+    tps = batch * seq / dt
+    n = model.cfg.param_count()
+    mfu = 6 * n * tps / 1e12 / V5E_PEAK_BF16_TFLOPS
+    out[f"llama3_1b_train_tokens_per_s_{label}"] = round(tps, 1)
+    out[f"llama3_1b_train_mfu_{label}"] = round(mfu, 4)
+    del p2, o2, l
+    gc.collect()
+    print(f"STEP train_{label}", flush=True)
+    part()
+
+# --- incremental decode (generate() KV-cache path) ------------------
+# Forced-sync timing (np.asarray, not block_until_ready): one r04 run
+# produced a physically impossible 34.7k tok/s via block_until_ready
+# on this tunnel; materializing the tokens is the trustworthy fence.
+# Sanity floor: b=1 decode of a 1.78 GiB bf16 model cannot beat the
+# ~2.2 ms/step HBM weight-streaming bound (~450 tok/s on a v5e).
+from rocnrdma_tpu.models.llama import generate
+model = make_model("llama3-1b")
+params = init_params(model, jax.random.PRNGKey(0))
+prompt = jnp.ones((1, 128), dtype=jnp.int32)
+for n in (64, 256):
+    toks = generate(model, params, prompt, n)
+    _ = np.asarray(toks)  # compile + settle
+    t0 = time.perf_counter()
+    toks = generate(model, params, prompt, n)
+    _ = np.asarray(toks)
+    dt = time.perf_counter() - t0
+    out[f"llama3_1b_decode_tokens_per_s_{n}new"] = round(n / dt, 1)
+print("STEP decode", flush=True)
+
+print("TPUBENCH " + json.dumps(out), flush=True)
+"""
+
+
+def main():
+    timeout_s = int(os.environ.get("TDR_CHASE_TIMEOUT_S", "1200"))
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    t0 = time.time()
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "which": "extra"}
+    results = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", BENCH % {"repo": REPO}],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        steps = [l for l in proc.stdout.splitlines() if l.startswith("STEP")]
+        rec["steps"] = len(steps)
+        partial_res = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("TPUBENCH "):
+                rec["ok"] = True
+                results = json.loads(line[len("TPUBENCH "):])
+            elif line.startswith("TPUPART "):
+                partial_res = json.loads(line[len("TPUPART "):])
+        if results is None:
+            rec["ok"] = False
+            rec["error"] = ("no TPUBENCH line; last stderr: " +
+                            (proc.stderr or "").strip()[-300:])
+            if partial_res is not None:
+                # Bank what completed before the failure, marked as such.
+                partial_res["partial"] = rec["error"]
+                results = partial_res
+    except subprocess.TimeoutExpired as e:
+        partial = e.stdout or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        steps = [l for l in partial.splitlines() if l.startswith("STEP")]
+        rec["ok"] = False
+        rec["steps"] = len(steps)
+        rec["error"] = f"timeout after {timeout_s}s ({len(steps)} steps)"
+        for line in partial.splitlines():
+            if line.startswith("TPUPART "):
+                results = json.loads(line[len("TPUPART "):])
+                results["partial"] = rec["error"]
+    rec["wall_s"] = round(time.time() - t0, 1)
+    with open(ATTEMPTS, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    if results is not None:
+        # Never let a degraded run clobber better banked evidence: a
+        # partial (or any) result only replaces an existing file if it
+        # completed at least as many sections.
+        if os.path.exists(RESULTS):
+            try:
+                with open(RESULTS) as f:
+                    prev = json.load(f)
+                if len(results) < len(prev):
+                    print("kept existing richer", RESULTS)
+                    return 0 if rec.get("ok") else 1
+            except Exception:  # noqa: BLE001 — unreadable prev: replace
+                pass
+        with open(RESULTS, "w") as f:
+            json.dump(results, f, indent=1)
+        print("banked:", RESULTS)
+        return 0 if rec.get("ok") else 1
+    print("failed:", rec.get("error"))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
