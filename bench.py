@@ -247,7 +247,11 @@ out["tpu_d2h_GBps"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
 
 sys.path.insert(0, %r)
 from rocnrdma_tpu.models.llama import make_model, init_params
-model = make_model("llama3-1b")
+# XLA baseline pinned explicitly (the model default is auto = Pallas
+# whenever the backend is TPU; the Pallas timing is banked separately
+# by tools/tpu_chase.py / tools/tpu_extra.py).
+model = make_model("llama3-1b", use_pallas_attention=False,
+                   use_pallas_rmsnorm=False)
 params = init_params(model, jax.random.PRNGKey(0))
 n_params = model.cfg.param_count()
 seq = 2048

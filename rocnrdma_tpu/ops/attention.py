@@ -12,9 +12,10 @@ TPU_RESULTS_r04_extra.json); elsewhere the XLA reference runs. The
 Pallas forward is wired through ``jax.custom_vjp`` with a
 rematerializing XLA backward so gradients work either way; a
 hand-written backward kernel is a later-round optimization. Under a
-multi-device pjit mesh the Trainer pins auto to the XLA path — the
-kernel has no GSPMD partitioning rule yet (shard_map wrapping is the
-planned fix), so GSPMD would replicate its operands.
+multi-device pjit mesh the kernel runs as a shard_map manual region
+(batch on dp, heads on tp — see ``ops/sharding.py``); geometries that
+don't divide the mesh fall back to the XLA reference, since GSPMD has
+no partitioning rule for a bare pallas_call.
 """
 
 from __future__ import annotations
@@ -25,6 +26,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from rocnrdma_tpu.ops import sharding as _sharding
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
@@ -189,7 +193,37 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 def attention(q, k, v, causal: bool = True, scale=None,
               use_pallas: bool = False, interpret: bool = False):
-    """Dispatcher: Pallas flash kernel or the XLA reference."""
-    if use_pallas:
-        return flash_attention(q, k, v, causal, scale, interpret=interpret)
-    return attention_reference(q, k, v, causal=causal, scale=scale)
+    """Dispatcher: Pallas flash kernel or the XLA reference.
+
+    Under an active :func:`ops.sharding.pallas_sharding` context the
+    kernel runs as a shard_map manual region — batch on the mesh's
+    batch axis, heads on its head axis (attention is independent per
+    head; GQA stays intact because each device keeps whole kv-head
+    groups). Shapes that don't divide the mesh (e.g. flax init's
+    batch-1 forward) take the XLA reference instead: a bare
+    pallas_call must never reach GSPMD's partitioner, which has no
+    rule for it."""
+    if not use_pallas:
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+
+    def local(q_, k_, v_):
+        return flash_attention(q_, k_, v_, causal, scale,
+                               DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, interpret)
+
+    def fits(mesh, ba, ha):
+        # kvh % t == 0 (with h % t == 0) also guarantees each device
+        # holds whole GQA groups: local q heads [i·h/t, (i+1)·h/t)
+        # map onto exactly the local kv heads [i·kvh/t, (i+1)·kvh/t).
+        return (ba in mesh.shape and ha in mesh.shape
+                and q.shape[0] % mesh.shape[ba] == 0
+                and q.shape[1] % mesh.shape[ha] == 0
+                and k.shape[1] % mesh.shape[ha] == 0)
+
+    def specs(ba, ha):
+        spec = P(ba, ha, None, None)
+        return (spec, spec, spec), spec
+
+    return _sharding.run_sharded(
+        local, (q, k, v), specs, fits,
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
+                                               scale=scale))

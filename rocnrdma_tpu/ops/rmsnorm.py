@@ -16,6 +16,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from rocnrdma_tpu.ops import sharding as _sharding
 
 _BLOCK_ROWS = 256
 
@@ -55,11 +58,7 @@ def rmsnorm_reference(x, w, eps: float = 1e-5):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def rmsnorm(x, w, eps: float = 1e-5, use_pallas: bool = True,
-            interpret: bool = False):
-    """RMSNorm over the last axis. ``use_pallas`` selects the fused
-    kernel for the forward pass; the backward pass is XLA (cheap and
-    fully fused by the compiler anyway)."""
+def _rmsnorm_cvjp(x, w, eps: float, use_pallas: bool, interpret: bool):
     if not use_pallas:
         return rmsnorm_reference(x, w, eps)
     shape = x.shape
@@ -68,8 +67,38 @@ def rmsnorm(x, w, eps: float = 1e-5, use_pallas: bool = True,
     return out.reshape(shape)
 
 
+def rmsnorm(x, w, eps: float = 1e-5, use_pallas: bool = True,
+            interpret: bool = False):
+    """RMSNorm over the last axis. ``use_pallas`` selects the fused
+    kernel for the forward pass; the backward pass is XLA (cheap and
+    fully fused by the compiler anyway).
+
+    Under an active :func:`ops.sharding.pallas_sharding` context the
+    kernel shard_maps over the mesh's batch axis (rows are
+    independent; the normalized axis stays local). Shapes that don't
+    divide fall back to the XLA reference — a bare pallas_call must
+    never reach GSPMD's partitioner."""
+    if not use_pallas:
+        return _rmsnorm_cvjp(x, w, eps, use_pallas, interpret)
+
+    def local(x_, w_):
+        return _rmsnorm_cvjp(x_, w_, eps, True, interpret)
+
+    def fits(mesh, ba, _ha):
+        return (ba in mesh.shape and x.ndim >= 2
+                and x.shape[0] % mesh.shape[ba] == 0)
+
+    def specs(ba, _ha):
+        spec_x = P(ba, *((None,) * (x.ndim - 1)))
+        return (spec_x, P(None)), spec_x
+
+    return _sharding.run_sharded(
+        local, (x, w), specs, fits,
+        lambda x_, w_: rmsnorm_reference(x_, w_, eps))
+
+
 def _rmsnorm_fwd(x, w, eps, use_pallas, interpret):
-    return rmsnorm(x, w, eps, use_pallas, interpret), (x, w)
+    return _rmsnorm_cvjp(x, w, eps, use_pallas, interpret), (x, w)
 
 
 def _rmsnorm_bwd(eps, use_pallas, interpret, res, g):
@@ -89,4 +118,4 @@ def _rmsnorm_bwd(eps, use_pallas, interpret, res, g):
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 
-rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)
+_rmsnorm_cvjp.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)

@@ -1,0 +1,74 @@
+"""SPMD dispatch context for the Pallas kernels.
+
+GSPMD has no partitioning rule for ``pallas_call``: under a
+multi-device pjit mesh it would replicate the kernel's operands
+(all-gathering tp-sharded activations) or fail outright. But both
+kernels are embarrassingly parallel along the axes the trainer shards
+— attention over (batch, heads), rmsnorm over leading rows — so the
+right SPMD story is a ``jax.shard_map`` manual region: each device
+runs the unmodified kernel on its local block and no collective is
+needed inside the region.
+
+The trainer (the only meshed consumer in-repo) enters
+:func:`pallas_sharding` around its traced calls; the op dispatchers in
+``ops.attention`` / ``ops.rmsnorm`` consult :func:`current` at trace
+time and wrap the kernel in shard_map when the operand shapes divide
+the mesh. When they don't (e.g. flax ``init`` runs a batch-1 forward),
+the dispatcher falls back to the XLA reference path so a bare
+pallas_call is never left for GSPMD to partition.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def pallas_sharding(mesh, batch_axis: str = "dp", head_axis: str = "tp"):
+    """While active (at trace time), Pallas ops shard_map over ``mesh``:
+    operand batch on ``batch_axis``, attention heads on ``head_axis``,
+    sequence and feature dims local to each device."""
+    prev = getattr(_TLS, "ctx", None)
+    _TLS.ctx = (mesh, batch_axis, head_axis)
+    try:
+        yield
+    finally:
+        _TLS.ctx = prev
+
+
+def current():
+    """The active (mesh, batch_axis, head_axis) context, or None."""
+    return getattr(_TLS, "ctx", None)
+
+
+def run_sharded(local_fn, args, specs_fn, fits_fn, fallback_fn):
+    """The one shard_map dispatch dance, shared by both kernels.
+
+    - no active context, or a 1-device mesh → ``local_fn(*args)``
+      (plain kernel; nothing for GSPMD to partition across devices);
+    - active context and ``fits_fn(mesh, batch_axis, head_axis)`` →
+      ``local_fn`` as a shard_map manual region with the specs from
+      ``specs_fn(batch_axis, head_axis) -> (in_specs, out_specs)``;
+    - active context but shapes don't divide → ``fallback_fn(*args)``
+      (the XLA reference — a bare pallas_call must never reach
+      GSPMD's partitioner, which has no rule for it).
+
+    check_vma=False: pallas_call's out_shape carries no varying-
+    mesh-axes annotation for shard_map's checker.
+    """
+    import jax
+
+    ctx = current()
+    if ctx is None:
+        return local_fn(*args)
+    mesh, ba, ha = ctx
+    if mesh.devices.size <= 1:
+        return local_fn(*args)
+    if not fits_fn(mesh, ba, ha):
+        return fallback_fn(*args)
+    in_specs, out_specs = specs_fn(ba, ha)
+    return jax.shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(*args)

@@ -18,6 +18,7 @@ whole step is one fused jit.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -26,7 +27,8 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from rocnrdma_tpu.models.llama import (
-    Llama, LlamaConfig, cross_entropy_loss, make_model)
+    Llama, LlamaConfig, cross_entropy_loss, make_model, resolve_pallas)
+from rocnrdma_tpu.ops.sharding import pallas_sharding
 from rocnrdma_tpu.parallel.mesh import (
     batch_spec, make_mesh, param_shardings, replicated)
 from rocnrdma_tpu.utils.trace import trace
@@ -53,24 +55,47 @@ class Trainer:
         self.model = make_model(config, **model_overrides)
         self.cfg = self.model.cfg
         self.mesh = make_mesh(mesh_shape or {"dp": 1, "tp": 1}, devices)
+        # GSPMD has no partitioning rule for pallas_call, so on a
+        # multi-device mesh the Pallas kernels can only run inside a
+        # shard_map manual region (ops/sharding.py): batch on dp,
+        # heads on tp. When the geometry shards cleanly, trace every
+        # step under that context; otherwise pin the auto flags to
+        # the XLA path, which GSPMD shards natively.
+        self._trace_ctx = contextlib.nullcontext
         if self.mesh.devices.size > 1:
-            # The Pallas kernels have no GSPMD partitioning rule yet:
-            # under a multi-device mesh GSPMD would replicate their
-            # operands (all-gathering tp-sharded activations). Pin the
-            # auto flags to the XLA path here — it shards cleanly —
-            # and leave explicit True to callers who shard_map it
-            # themselves. Single-device meshes keep Pallas-on-TPU.
-            pins = {f: False for f in ("use_pallas_attention",
-                                       "use_pallas_rmsnorm")
-                    if getattr(self.cfg, f) is None}
-            if pins:
-                self.model = make_model(self.cfg, **pins)
-                self.cfg = self.model.cfg
+            tp = self.mesh.shape.get("tp", 1)
+            pallas_on = (resolve_pallas(self.cfg.use_pallas_attention)
+                         or resolve_pallas(self.cfg.use_pallas_rmsnorm))
+            shardable = ("dp" in self.mesh.shape
+                         and self.cfg.n_heads % tp == 0
+                         and self.cfg.n_kv_heads % tp == 0)
+            if pallas_on and shardable:
+                self._trace_ctx = lambda: pallas_sharding(
+                    self.mesh, batch_axis="dp", head_axis="tp")
+            elif (self.cfg.use_pallas_attention
+                  or self.cfg.use_pallas_rmsnorm):
+                # Explicitly-requested Pallas that cannot shard must
+                # fail loudly, not leave a bare pallas_call for GSPMD
+                # (no partitioning rule → replicated operands or a
+                # compile error on TPU).
+                raise ValueError(
+                    f"use_pallas_*=True on a {self.mesh.devices.size}-"
+                    f"device mesh, but n_heads={self.cfg.n_heads}/"
+                    f"n_kv_heads={self.cfg.n_kv_heads} don't divide "
+                    f"tp={tp} (or the mesh lacks a dp axis); set the "
+                    "flags to None (auto) or fix the mesh")
+            else:
+                pins = {f: False for f in ("use_pallas_attention",
+                                           "use_pallas_rmsnorm")
+                        if getattr(self.cfg, f) is None}
+                if pins:
+                    self.model = make_model(self.cfg, **pins)
+                    self.cfg = self.model.cfg
         self.tx = optax.adamw(learning_rate, weight_decay=weight_decay)
         self.cross_slice_sync = cross_slice_sync
 
         rng = jax.random.PRNGKey(seed)
-        with self.mesh:
+        with self.mesh, self._trace_ctx():
             abstract = jax.eval_shape(
                 lambda r: self.model.init(
                     r, jnp.zeros((1, 8), dtype=jnp.int32)), rng)
@@ -125,7 +150,9 @@ class Trainer:
     def step(self, tokens) -> float:
         """One optimizer step; returns the (pre-update) loss."""
         tokens = self.shard_batch(tokens)
-        with self.mesh:
+        # _trace_ctx matters only on the first call (trace time); it is
+        # a no-op for steady-state dispatch of the compiled step.
+        with self.mesh, self._trace_ctx():
             if self.cross_slice_sync is None:
                 self.params, self.opt_state, loss = self._jit_full(
                     self.params, self.opt_state, tokens)

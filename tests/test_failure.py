@@ -23,6 +23,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _run(script: str, timeout=120) -> subprocess.CompletedProcess:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # These tests assert "peer death surfaces PROMPTLY" — the ring
+    # stall deadline is part of what's under test, so the subprocess
+    # must not inherit the suite-wide 120 s contention allowance from
+    # conftest (the dead-peer path usually flushes in ms via TCP
+    # close, but when the teardown races bootstrap the deadline is
+    # the backstop, and it must fire well inside this harness
+    # timeout).
+    env["TDR_RING_TIMEOUT_MS"] = "20000"
     return subprocess.run([sys.executable, "-c", script], env=env,
                           capture_output=True, text=True, timeout=timeout)
 

@@ -73,7 +73,11 @@ for k in (4096, 8192):
     print(f"STEP matmul_{k}", flush=True)
 
 from rocnrdma_tpu.models.llama import make_model, init_params
-model = make_model("llama3-1b")
+# Baseline = XLA path, pinned explicitly: the model flags default to
+# auto (= Pallas on TPU), which would make this "baseline" measure
+# Pallas against itself.
+model = make_model("llama3-1b", use_pallas_attention=False,
+                   use_pallas_rmsnorm=False)
 params = init_params(model, jax.random.PRNGKey(0))
 params = jax.device_put(params, dev)
 seq = 2048
@@ -92,7 +96,8 @@ out["llama3_1b_params"] = n_params
 out["llama3_1b_fwd_TFLOPs"] = round(2 * n_params * (seq / dt) / 1e12, 2)
 print("STEP llama", flush=True)
 
-# Pallas-vs-XLA forward timing (the kernels default off; measure both).
+# Pallas-vs-XLA forward timing (explicit flags on both sides; the
+# model default is auto = Pallas-on-TPU).
 try:
     import os as _os
     from rocnrdma_tpu.models.llama import make_model as mk
