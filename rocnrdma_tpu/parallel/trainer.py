@@ -64,33 +64,43 @@ class Trainer:
         self._trace_ctx = contextlib.nullcontext
         if self.mesh.devices.size > 1:
             tp = self.mesh.shape.get("tp", 1)
-            pallas_on = (resolve_pallas(self.cfg.use_pallas_attention)
-                         or resolve_pallas(self.cfg.use_pallas_rmsnorm))
-            shardable = ("dp" in self.mesh.shape
-                         and self.cfg.n_heads % tp == 0
-                         and self.cfg.n_kv_heads % tp == 0)
-            if pallas_on and shardable:
+            # Per-kernel shardability: rmsnorm shard_maps over rows and
+            # only needs the dp axis; attention additionally needs the
+            # heads (incl. GQA kv heads) to divide tp.
+            rms_ok = "dp" in self.mesh.shape
+            attn_ok = (rms_ok and "tp" in self.mesh.shape
+                       and self.cfg.n_heads % tp == 0
+                       and self.cfg.n_kv_heads % tp == 0)
+            # Explicitly-requested Pallas that cannot shard must fail
+            # loudly, not leave a bare pallas_call for GSPMD (no
+            # partitioning rule → replicated operands or a compile
+            # error on TPU) or silently degrade.
+            if self.cfg.use_pallas_attention and not attn_ok:
+                raise ValueError(
+                    f"use_pallas_attention=True on a "
+                    f"{self.mesh.devices.size}-device mesh, but "
+                    f"n_heads={self.cfg.n_heads}/n_kv_heads="
+                    f"{self.cfg.n_kv_heads} don't divide tp={tp} (or "
+                    "the mesh lacks dp/tp axes); set the flag to None "
+                    "(auto) or fix the mesh")
+            if self.cfg.use_pallas_rmsnorm and not rms_ok:
+                raise ValueError(
+                    "use_pallas_rmsnorm=True on a multi-device mesh "
+                    "without a dp axis; set the flag to None (auto) "
+                    "or add a dp axis")
+            pins = {}
+            if self.cfg.use_pallas_attention is None and not attn_ok:
+                pins["use_pallas_attention"] = False
+            if self.cfg.use_pallas_rmsnorm is None and not rms_ok:
+                pins["use_pallas_rmsnorm"] = False
+            if pins:
+                self.model = make_model(self.cfg, **pins)
+                self.cfg = self.model.cfg
+            if ((resolve_pallas(self.cfg.use_pallas_attention) and attn_ok)
+                    or (resolve_pallas(self.cfg.use_pallas_rmsnorm)
+                        and rms_ok)):
                 self._trace_ctx = lambda: pallas_sharding(
                     self.mesh, batch_axis="dp", head_axis="tp")
-            elif (self.cfg.use_pallas_attention
-                  or self.cfg.use_pallas_rmsnorm):
-                # Explicitly-requested Pallas that cannot shard must
-                # fail loudly, not leave a bare pallas_call for GSPMD
-                # (no partitioning rule → replicated operands or a
-                # compile error on TPU).
-                raise ValueError(
-                    f"use_pallas_*=True on a {self.mesh.devices.size}-"
-                    f"device mesh, but n_heads={self.cfg.n_heads}/"
-                    f"n_kv_heads={self.cfg.n_kv_heads} don't divide "
-                    f"tp={tp} (or the mesh lacks a dp axis); set the "
-                    "flags to None (auto) or fix the mesh")
-            else:
-                pins = {f: False for f in ("use_pallas_attention",
-                                           "use_pallas_rmsnorm")
-                        if getattr(self.cfg, f) is None}
-                if pins:
-                    self.model = make_model(self.cfg, **pins)
-                    self.cfg = self.model.cfg
         self.tx = optax.adamw(learning_rate, weight_decay=weight_decay)
         self.cross_slice_sync = cross_slice_sync
 
