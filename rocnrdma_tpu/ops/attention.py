@@ -9,9 +9,12 @@ live in VMEM scratch across it (the canonical Pallas flash pattern).
 Dispatch: the model flags default to auto — on TPU backends the Pallas
 forward IS the compute path (single-chip benched live: see
 TPU_RESULTS_r04_extra.json); elsewhere the XLA reference runs. The
-Pallas forward is wired through ``jax.custom_vjp`` with a
-rematerializing XLA backward so gradients work either way; a
-hand-written backward kernel is a later-round optimization. Under a
+backward is hand-written Pallas too (``_flash_backward``): the forward
+saves the per-row log-sum-exp, delta = rowsum(dO∘O) supplies the
+softmax-gradient correction, and two tiled kernels produce dK/dV
+(inner loop over q blocks) and dQ (inner loop over kv blocks) without
+ever materializing the S×S matrix in HBM — set ``TDR_FLASH_BWD=remat``
+to fall back to the old rematerializing XLA backward. Under a
 multi-device pjit mesh the kernel runs as a shard_map manual region
 (batch on dp, heads on tp — see ``ops/sharding.py``); geometries that
 don't divide the mesh fall back to the XLA reference, since GSPMD has
@@ -21,6 +24,7 @@ no partitioning rule for a bare pallas_call.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +37,23 @@ from rocnrdma_tpu.ops import sharding as _sharding
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
+
+
+def _resolve_scale(scale, d: int) -> float:
+    """One place derives the default softmax scale — the custom_vjp
+    forward and backward must agree on it."""
+    return scale if scale is not None else 1.0 / (d ** 0.5)
+
+
+def _check_blocks(block_q: int, block_k: int):
+    """The padding convention (s_pad = multiple of max(bq, bk)) only
+    tiles the sequence exactly when one block divides the other —
+    otherwise the grid silently drops the tail."""
+    hi, lo = max(block_q, block_k), min(block_q, block_k)
+    if hi % lo != 0:
+        raise ValueError(
+            f"block_q={block_q} and block_k={block_k} must divide one "
+            "another (the padded sequence is tiled by both)")
 
 
 def attention_reference(q, k, v, causal: bool = True, scale=None):
@@ -53,9 +74,9 @@ def attention_reference(q, k, v, causal: bool = True, scale=None):
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale: float, block_q: int, block_k: int, seq_len: int,
-                  causal: bool):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                  acc_ref, *, scale: float, block_q: int, block_k: int,
+                  seq_len: int, causal: bool):
     qi = pl.program_id(2)
     kj = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -110,6 +131,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finish():
         o_ref[0, 0] = (acc_ref[:] /
                        jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        # Row log-sum-exp, saved for the backward kernels: with it,
+        # p_ij = exp(s_ij - lse_i) reconstructs the softmax without
+        # re-running the online max/denominator recursion.
+        lse_ref[0, 0] = (m_ref[:] +
+                         jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
@@ -119,6 +145,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     group = h // kvh
     block_q = min(block_q, s)
     block_k = min(block_k, s)
+    _check_blocks(block_q, block_k)
 
     s_pad = pl.cdiv(s, max(block_q, block_k)) * max(block_q, block_k)
     if s_pad != s:
@@ -131,9 +158,10 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     kernel = functools.partial(
         _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
         seq_len=s, causal=causal)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, s_pad), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
@@ -143,8 +171,10 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_specs=(pl.BlockSpec((1, 1, block_q, d),
+                                lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+                   pl.BlockSpec((1, 1, block_q),
+                                lambda bi, hi, qi, ki: (bi, hi, qi))),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -156,7 +186,225 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
         ),
         interpret=interpret,
     )(q, k, v)
-    return out[:, :, :s, :]
+    return out[:, :, :s, :], lse[:, :, :s]
+
+
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+              q_start, k_start, *, scale: float, block_q: int,
+              block_k: int, seq_len: int, causal: bool):
+    """Recompute one (block_q × block_k) tile of the softmax and its
+    gradient: returns (p, ds, q, k, do) in f32. Shared by the dK/dV
+    and dQ kernels so the mask/scale reconstruction cannot diverge
+    between them (and mirrors the forward's masking exactly)."""
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]          # (bq, 1)
+    delta = delta_ref[0, 0][:, None]      # (bq, 1)
+
+    s = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    q_idx = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_idx = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = k_idx < seq_len
+    if causal:
+        mask = jnp.logical_and(mask, k_idx <= q_idx)
+    s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lse)                  # (bq, bk)
+    dp = jax.lax.dot_general(
+        do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return p, ds, q, k, do
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    block_q: int, block_k: int, seq_len: int,
+                    causal: bool, nq: int):
+    """dK/dV for one KV-HEAD-granular kv block. The sequential inner
+    grid dim walks group × q-blocks (all q heads of the GQA group,
+    each over all q blocks), accumulating into one (block_k, d)
+    scratch pair — the group sum happens in VMEM, so HBM only ever
+    sees the (B, KVH, S, D) result."""
+    t = pl.program_id(3)
+    nt = pl.num_programs(3)          # = group * nq
+    qi = t % nq                      # q block within the current head
+
+    @pl.when(t == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = pl.program_id(2) * block_k
+    if causal:
+        run = k_start <= q_start + block_q - 1
+    else:
+        run = t >= 0  # always true, but traced
+
+    @pl.when(run)
+    def _body():
+        p, ds, q, _k, do = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            q_start, k_start, scale=scale, block_q=block_q,
+            block_k=block_k, seq_len=seq_len, causal=causal)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == nt - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale: float, block_q: int,
+                   block_k: int, seq_len: int, causal: bool):
+    """dQ for one q block: sequential inner loop over kv blocks."""
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = kj * block_k
+    if causal:
+        run = k_start <= q_start + block_q - 1
+    else:
+        run = kj >= 0
+
+    @pl.when(run)
+    def _body():
+        _p, ds, _q, k, _do = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            q_start, k_start, scale=scale, block_q=block_q,
+            block_k=block_k, seq_len=seq_len, causal=causal)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, do, scale: float, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+    """Full Pallas backward: dq, dk, dv without ever materializing the
+    S×S attention matrix in HBM (delta + lse reconstruct each tile).
+    dK/dV are produced directly at kv-head granularity — the GQA
+    group sum accumulates in VMEM scratch inside the kernel."""
+    b, h, s, d = q.shape
+    kvh = k.shape[1]
+    group = h // kvh
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    _check_blocks(block_q, block_k)
+
+    # delta_i = rowsum(dO ∘ O): the dP→dS correction term.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # (b, h, s) f32
+
+    s_pad = pl.cdiv(s, max(block_q, block_k)) * max(block_q, block_k)
+    if s_pad != s:
+        pad4 = [(0, 0), (0, 0), (0, s_pad - s), (0, 0)]
+        pad3 = [(0, 0), (0, 0), (0, s_pad - s)]
+        q = jnp.pad(q, pad4)
+        k = jnp.pad(k, pad4)
+        v = jnp.pad(v, pad4)
+        do = jnp.pad(do, pad4)   # zero dO rows ⇒ padded rows are inert
+        lse = jnp.pad(lse, pad3)
+        delta = jnp.pad(delta, pad3)
+
+    nq = s_pad // block_q
+    common = dict(scale=scale, block_q=block_q, block_k=block_k,
+                  seq_len=s, causal=causal)
+
+    # dK/dV at KV-head granularity: grid dim 1 is the kv head, and the
+    # sequential dim walks group × q-blocks — q-head index = kv·g +
+    # t//nq — so the GQA group sum accumulates in VMEM scratch and HBM
+    # only holds (B, KVH, S, D) outputs (not group× q-head copies).
+    dkv_grid = (b, kvh, s_pad // block_k, group * nq)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, nq=nq, **common),
+        out_shape=(jax.ShapeDtypeStruct((b, kvh, s_pad, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, kvh, s_pad, d), v.dtype)),
+        grid=dkv_grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, kv, ki, t, g=group, n=nq:
+                         (bi, kv * g + t // n, t % n, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, kv, ki, t: (bi, kv, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, kv, ki, t: (bi, kv, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, kv, ki, t, g=group, n=nq:
+                         (bi, kv * g + t // n, t % n, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, kv, ki, t, g=group, n=nq:
+                         (bi, kv * g + t // n, t % n)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, kv, ki, t, g=group, n=nq:
+                         (bi, kv * g + t // n, t % n)),
+        ],
+        out_specs=(pl.BlockSpec((1, 1, block_k, d),
+                                lambda bi, kv, ki, t: (bi, kv, ki, 0)),
+                   pl.BlockSpec((1, 1, block_k, d),
+                                lambda bi, kv, ki, t: (bi, kv, ki, 0))),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq_grid = (b, h, s_pad // block_q, s_pad // block_k)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
+        grid=dq_grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, kj, g=group: (bi, hi // g, kj, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, kj, g=group: (bi, hi // g, kj, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, qi, kj: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, qi, kj: (bi, hi, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    return dq[:, :, :s, :], dk[:, :, :s, :], dv[:, :, :s, :]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -164,28 +412,41 @@ def flash_attention(q, k, v, causal: bool = True, scale=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
-    """Pallas flash attention forward; differentiable (XLA backward)."""
-    d = q.shape[-1]
-    sc = scale if scale is not None else 1.0 / (d ** 0.5)
-    return _flash_forward(q, k, v, sc, causal, block_q, block_k, interpret)
+    """Pallas flash attention; differentiable (Pallas backward)."""
+    sc = _resolve_scale(scale, q.shape[-1])
+    out, _ = _flash_forward(q, k, v, sc, causal, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
-    return out, (q, k, v)
+    sc = _resolve_scale(scale, q.shape[-1])
+    out, lse = _flash_forward(q, k, v, sc, causal, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # Rematerializing XLA backward: recompute the reference forward and
-    # differentiate it. Memory cost O(S²) per block of heads — fine at
-    # the sizes the training tests use; a Pallas backward kernel is the
-    # planned replacement.
-    q, k, v = res
-    def f(q_, k_, v_):
-        return attention_reference(q_, k_, v_, causal=causal, scale=scale)
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    sc = _resolve_scale(scale, q.shape[-1])
+    # NOTE: read at TRACE time — changing it after a train step has
+    # jit-compiled does not switch the already-cached backward.
+    knob = os.environ.get("TDR_FLASH_BWD", "pallas")
+    if knob not in ("pallas", "remat"):
+        raise ValueError(
+            f"TDR_FLASH_BWD={knob!r}: must be 'pallas' (tiled Pallas "
+            "backward, default) or 'remat' (rematerializing XLA "
+            "backward)")
+    if knob == "remat":
+        # Fallback: recompute the reference forward and differentiate
+        # it (materializes S² per head — the pre-round-4 behavior).
+        def f(q_, k_, v_):
+            return attention_reference(q_, k_, v_, causal=causal,
+                                       scale=scale)
+        _, vjp = jax.vjp(f, q, k, v)
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, sc, causal, block_q,
+                           block_k, interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
