@@ -179,6 +179,12 @@ def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
             w.close()
     finally:
       os.environ.pop("TDR_NO_STAGE_PIPELINE", None)
+    # On this 1-vCPU host pipelined ≈ serial by construction: the
+    # D2H gather, ring, and H2D scatter are all CPU work sharing one
+    # core, so there is nothing to overlap WITH. The pipeline pays on
+    # hosts where staging copies ride a DMA engine / second core.
+    out["staged_note"] = ("pipelined==serial expected on 1-vCPU hosts; "
+                          "overlap needs a second engine")
     return out
 
 

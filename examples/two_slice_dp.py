@@ -40,17 +40,8 @@ def parse_mesh(spec: str):
 
 def run_slice(rank: int, world: int, base_port: int, peers, args):
     if args.force_cpu:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags +
-                f" --xla_force_host_platform_device_count={args.devices}"
-            ).strip()
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        if "jax" in sys.modules:
-            import jax
-
-            jax.config.update("jax_platforms", "cpu")
+        from rocnrdma_tpu.utils.hostenv import force_cpu_backend
+        force_cpu_backend(virtual_devices=args.devices)
     import numpy as np
 
     from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce

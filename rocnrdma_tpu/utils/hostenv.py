@@ -1,0 +1,44 @@
+"""Host-environment hygiene for hardware-free runs.
+
+This image pins JAX at a TPU device tunnel (``JAX_PLATFORMS=axon`` plus
+a ``.axon_site`` sitecustomize on PYTHONPATH that pre-imports jax at
+interpreter start). The tunnel hangs for minutes when unreachable, so
+anything that wants to run hardware-free must (a) hard-set the platform
+to cpu, (b) shed the sitecustomize from both ``sys.path`` and
+``PYTHONPATH`` (for subprocesses), and (c) if jax was already imported,
+flip the platform through the config API — env vars are too late then.
+
+This module deliberately imports nothing heavy so it can run before
+jax. ``tests/conftest.py`` keeps its own inlined copy of this dance:
+it must execute before the test process imports ANY package module,
+so it cannot depend on this one.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def force_cpu_backend(virtual_devices: int | None = None) -> None:
+    """Pin this process (and its children) to the CPU backend.
+
+    ``virtual_devices`` adds ``--xla_force_host_platform_device_count``
+    so multi-chip sharding code runs on a virtual mesh.
+    """
+    if virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={virtual_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    os.environ["PYTHONPATH"] = ":".join(
+        p for p in os.environ.get("PYTHONPATH", "").split(":")
+        if p and ".axon_site" not in p)
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

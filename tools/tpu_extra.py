@@ -150,6 +150,41 @@ for label, overrides in (("xla", {"use_pallas_attention": False,
     print(f"STEP train_{label}", flush=True)
     part()
 
+# --- long-sequence attention: where flash pays ----------------------
+# At seq 8192 the XLA reference materializes a (1,16,S,S) f32 score
+# tensor (~4 GiB at 8k) per call; flash streams tiles through VMEM and
+# its Pallas backward never builds S^2 in HBM. Failures (OOM) are
+# recorded per entry — "XLA cannot, flash can" is itself the result.
+# (attention_reference / flash_attention already imported above.)
+ls = {}
+for seq_l in (4096, 8192):
+    kq2, kk2, kv2 = jax.random.split(jax.random.PRNGKey(seq_l), 3)
+    ql = jax.random.normal(kq2, (1, 16, seq_l, 128), jnp.bfloat16)
+    kl = jax.random.normal(kk2, (1, 8, seq_l, 128), jnp.bfloat16)
+    vl = jax.random.normal(kv2, (1, 8, seq_l, 128), jnp.bfloat16)
+    impls = (("pallas", lambda q_, k_, v_: flash_attention(q_, k_, v_, True)),
+             ("xla", lambda q_, k_, v_: attention_reference(q_, k_, v_, True)))
+    for label, fn in impls:
+        try:
+            t, _ = timeit(jax.jit(fn), ql, kl, vl, reps=5)
+            ls[f"fwd_{label}_s{seq_l}_us"] = round(t * 1e6, 1)
+        except Exception as e:
+            ls[f"fwd_{label}_s{seq_l}_us"] = f"failed: {type(e).__name__}"
+    for label, fn in impls:
+        try:
+            gfn = jax.jit(jax.grad(
+                lambda q_, k_, v_, f=fn: f(q_, k_, v_).astype(
+                    jnp.float32).sum(), argnums=(0, 1, 2)))
+            t, _ = timeit(gfn, ql, kl, vl, reps=3)
+            ls[f"grad_{label}_s{seq_l}_us"] = round(t * 1e6, 1)
+        except Exception as e:
+            ls[f"grad_{label}_s{seq_l}_us"] = f"failed: {type(e).__name__}"
+    del ql, kl, vl
+    gc.collect()
+out["long_seq_attention"] = ls
+print("STEP longseq", flush=True)
+part()
+
 # --- incremental decode (generate() KV-cache path) ------------------
 # Forced-sync timing (np.asarray, not block_until_ready): one r04 run
 # produced a physically impossible 34.7k tok/s via block_until_ready
