@@ -82,11 +82,12 @@ def main():
         return optax.apply_updates(p, updates), o, loss
 
     rng = np.random.default_rng(0)
-    tokens = rng.integers(0, model.cfg.vocab_size,
-                          (args.batch, args.seq + 1)).astype(np.int32)
+    tokens = jnp.asarray(rng.integers(
+        0, model.cfg.vocab_size,
+        (args.batch, args.seq + 1)).astype(np.int32))
 
     t_compile = time.perf_counter()
-    params, opt, loss = step(params, opt, jnp.asarray(tokens))
+    params, opt, loss = step(params, opt, tokens)
     jax.block_until_ready(loss)
     print(f"step 0 (compile): loss={float(loss):.4f} "
           f"[{time.perf_counter() - t_compile:.1f}s]")
@@ -95,7 +96,7 @@ def main():
         return  # no post-compile steps — no throughput to report
     t0 = time.perf_counter()
     for i in range(1, args.steps):
-        params, opt, loss = step(params, opt, jnp.asarray(tokens))
+        params, opt, loss = step(params, opt, tokens)
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / (args.steps - 1)
     print(f"step {args.steps - 1}: loss={float(loss):.4f} "

@@ -133,9 +133,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                        jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
         # Row log-sum-exp, saved for the backward kernels: with it,
         # p_ij = exp(s_ij - lse_i) reconstructs the softmax without
-        # re-running the online max/denominator recursion.
-        lse_ref[0, 0] = (m_ref[:] +
-                         jnp.log(jnp.maximum(l_ref[:], 1e-30)))[:, 0]
+        # re-running the online max/denominator recursion. Carried as
+        # (…, S, 1): a trailing unit dim keeps the block's last two
+        # dims (block_q, 1) legal under Mosaic's tiling rule, where a
+        # 3-D (…, block_q) block is not.
+        lse_ref[0, 0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
@@ -161,7 +163,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((b, h, s_pad, d), q.dtype),
-                   jax.ShapeDtypeStruct((b, h, s_pad), jnp.float32)),
+                   jax.ShapeDtypeStruct((b, h, s_pad, 1), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
@@ -173,8 +175,8 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
         ],
         out_specs=(pl.BlockSpec((1, 1, block_q, d),
                                 lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-                   pl.BlockSpec((1, 1, block_q),
-                                lambda bi, hi, qi, ki: (bi, hi, qi))),
+                   pl.BlockSpec((1, 1, block_q, 1),
+                                lambda bi, hi, qi, ki: (bi, hi, qi, 0))),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -186,7 +188,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
         ),
         interpret=interpret,
     )(q, k, v)
-    return out[:, :, :s, :], lse[:, :, :s]
+    return out[:, :, :s, :], lse[:, :, :s, :]
 
 
 def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -200,8 +202,8 @@ def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]          # (bq, 1)
-    delta = delta_ref[0, 0][:, None]      # (bq, 1)
+    lse = lse_ref[0, 0]                   # (bq, 1) — see lse layout note
+    delta = delta_ref[0, 0]               # (bq, 1)
 
     s = jax.lax.dot_general(
         q, k, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -313,20 +315,20 @@ def _flash_backward(q, k, v, out, lse, do, scale: float, causal: bool,
     block_k = min(block_k, s)
     _check_blocks(block_q, block_k)
 
-    # delta_i = rowsum(dO ∘ O): the dP→dS correction term.
+    # delta_i = rowsum(dO ∘ O): the dP→dS correction term. Kept
+    # (b, h, s, 1) like lse — Mosaic-legal trailing block dims.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)  # (b, h, s) f32
+                    axis=-1, keepdims=True)  # (b, h, s, 1) f32
 
     s_pad = pl.cdiv(s, max(block_q, block_k)) * max(block_q, block_k)
     if s_pad != s:
         pad4 = [(0, 0), (0, 0), (0, s_pad - s), (0, 0)]
-        pad3 = [(0, 0), (0, 0), (0, s_pad - s)]
         q = jnp.pad(q, pad4)
         k = jnp.pad(k, pad4)
         v = jnp.pad(v, pad4)
         do = jnp.pad(do, pad4)   # zero dO rows ⇒ padded rows are inert
-        lse = jnp.pad(lse, pad3)
-        delta = jnp.pad(delta, pad3)
+        lse = jnp.pad(lse, pad4)
+        delta = jnp.pad(delta, pad4)
 
     nq = s_pad // block_q
     common = dict(scale=scale, block_q=block_q, block_k=block_k,
@@ -353,12 +355,12 @@ def _flash_backward(q, k, v, out, lse, do, scale: float, causal: bool,
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, kv, ki, t, g=group, n=nq:
                          (bi, kv * g + t // n, t % n, 0)),
-            pl.BlockSpec((1, 1, block_q),
+            pl.BlockSpec((1, 1, block_q, 1),
                          lambda bi, kv, ki, t, g=group, n=nq:
-                         (bi, kv * g + t // n, t % n)),
-            pl.BlockSpec((1, 1, block_q),
+                         (bi, kv * g + t // n, t % n, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
                          lambda bi, kv, ki, t, g=group, n=nq:
-                         (bi, kv * g + t // n, t % n)),
+                         (bi, kv * g + t // n, t % n, 0)),
         ],
         out_specs=(pl.BlockSpec((1, 1, block_k, d),
                                 lambda bi, kv, ki, t: (bi, kv, ki, 0)),
@@ -389,10 +391,10 @@ def _flash_backward(q, k, v, out, lse, do, scale: float, causal: bool,
                          lambda bi, hi, qi, kj, g=group: (bi, hi // g, kj, 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda bi, hi, qi, kj: (bi, hi, qi)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda bi, hi, qi, kj: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bi, hi, qi, kj: (bi, hi, qi, 0)),

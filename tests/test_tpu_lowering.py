@@ -1,0 +1,47 @@
+"""TPU cross-lowering checks for the Pallas kernels.
+
+Interpret mode validates numerics but NOT the Mosaic lowering — round 4
+shipped an lse output whose (1, 1, block_q) block violated Mosaic's
+last-two-dims tiling rule, invisible to every interpret-mode test and
+fatal on hardware. ``jax.export`` with ``platforms=["tpu"]`` runs the
+Pallas→Mosaic lowering on this CPU-only host, so tiling/layout
+violations fail HERE instead of on the (intermittently reachable)
+chip. Shapes are the llama3-1b production geometry (head_dim 128).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import export
+
+from rocnrdma_tpu.ops.attention import flash_attention
+from rocnrdma_tpu.ops.rmsnorm import rmsnorm
+
+Q = jax.ShapeDtypeStruct((1, 16, 2048, 128), jnp.bfloat16)
+KV = jax.ShapeDtypeStruct((1, 8, 2048, 128), jnp.bfloat16)
+
+
+def test_flash_attention_fwd_lowers_for_tpu():
+    exp = export.export(
+        jax.jit(lambda q, k, v: flash_attention(q, k, v, True)),
+        platforms=["tpu"])(Q, KV, KV)
+    assert "tpu" in [p.lower() for p in exp.platforms]
+
+
+def test_flash_attention_bwd_lowers_for_tpu():
+    exp = export.export(
+        jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, True)
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2))),
+        platforms=["tpu"])(Q, KV, KV)
+    assert "tpu" in [p.lower() for p in exp.platforms]
+
+
+def test_rmsnorm_fwd_and_bwd_lower_for_tpu():
+    x = jax.ShapeDtypeStruct((8, 2048, 2048), jnp.bfloat16)
+    w = jax.ShapeDtypeStruct((2048,), jnp.float32)
+    export.export(jax.jit(lambda x, w: rmsnorm(x, w)),
+                  platforms=["tpu"])(x, w)
+    export.export(
+        jax.jit(jax.grad(
+            lambda x, w: rmsnorm(x, w).astype(jnp.float32).sum(),
+            argnums=(0, 1))), platforms=["tpu"])(x, w)
