@@ -56,6 +56,43 @@ def _check_blocks(block_q: int, block_k: int):
             "another (the padded sequence is tiled by both)")
 
 
+def _last_kv_block(qi, block_q: int, block_k: int):
+    """Index of the LAST kv block a causal q block attends to. The
+    single source of the diagonal arithmetic: the kernels' run
+    predicates and the fetch-skip clamps must agree exactly — a
+    compute step that runs while its fetch was clamped would read the
+    wrong block."""
+    return (qi * block_q + block_q - 1) // block_k
+
+
+def _first_q_block(ki, block_q: int, block_k: int):
+    """Index of the FIRST causal q block whose rows see kv block
+    ``ki`` (dual of :func:`_last_kv_block`)."""
+    return (ki * block_k) // block_q
+
+
+def _clamp_kv(ki, qi, block_q: int, block_k: int, causal: bool):
+    """Causal fetch-skip for kernels whose inner loop walks kv blocks:
+    kv blocks entirely above the diagonal contribute nothing, so remap
+    their fetch to the last contributing block. Consecutive grid steps
+    with the SAME block index elide the copy in Mosaic's pipeline —
+    the skipped blocks are never pulled from HBM (their compute is
+    separately gated by the ``run`` predicate)."""
+    if not causal:
+        return ki
+    return jnp.minimum(ki, _last_kv_block(qi, block_q, block_k))
+
+
+def _clamp_q(qi, ki, block_q: int, block_k: int, causal: bool):
+    """Dual of :func:`_clamp_kv` for the dK/dV kernel, whose inner
+    loop walks q blocks: q blocks entirely above the diagonal (their
+    rows see none of this kv block) pin the fetch to the first
+    contributing q block."""
+    if not causal:
+        return qi
+    return jnp.maximum(qi, _first_q_block(ki, block_q, block_k))
+
+
 def attention_reference(q, k, v, causal: bool = True, scale=None):
     """(B, H, S, D) x (B, KVH, S, D) -> (B, H, S, D); XLA path."""
     b, h, s, d = q.shape
@@ -91,10 +128,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     k_start = kj * block_k
 
     # Causal: a kv block entirely above the q block's diagonal
-    # contributes nothing → skip its compute (the block is still
-    # fetched; index-map-level skipping is a later optimization).
+    # contributes nothing → skip its compute, and its FETCH is elided
+    # too (the kv index map clamps via _clamp_kv, so the skipped
+    # iterations re-present the previous block). run must agree with
+    # the clamp exactly — both derive from _last_kv_block.
     if causal:
-        run = k_start <= q_start + block_q - 1
+        run = kj <= _last_kv_block(qi, block_q, block_k)
     else:
         run = kj >= 0  # always true, but traced
 
@@ -169,9 +208,13 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+                         lambda bi, hi, qi, ki, g=group:
+                         (bi, hi // g,
+                          _clamp_kv(ki, qi, block_q, block_k, causal), 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+                         lambda bi, hi, qi, ki, g=group:
+                         (bi, hi // g,
+                          _clamp_kv(ki, qi, block_q, block_k, causal), 0)),
         ],
         out_specs=(pl.BlockSpec((1, 1, block_q, d),
                                 lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -242,10 +285,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
+    ki = pl.program_id(2)
     q_start = qi * block_q
-    k_start = pl.program_id(2) * block_k
+    k_start = ki * block_k
+    # run must agree with the _clamp_q fetch clamp — both derive from
+    # _first_q_block.
     if causal:
-        run = k_start <= q_start + block_q - 1
+        run = qi >= _first_q_block(ki, block_q, block_k)
     else:
         run = t >= 0  # always true, but traced
 
@@ -282,8 +328,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q_start = qi * block_q
     k_start = kj * block_k
+    # run must agree with the _clamp_kv fetch clamp — both derive
+    # from _last_kv_block.
     if causal:
-        run = k_start <= q_start + block_q - 1
+        run = kj <= _last_kv_block(qi, block_q, block_k)
     else:
         run = kj >= 0
 
@@ -347,20 +395,28 @@ def _flash_backward(q, k, v, out, lse, do, scale: float, causal: bool,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, kv, ki, t, g=group, n=nq:
-                         (bi, kv * g + t // n, t % n, 0)),
+                         (bi, kv * g + t // n,
+                          _clamp_q(t % n, ki, block_q, block_k, causal),
+                          0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, kv, ki, t: (bi, kv, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, kv, ki, t: (bi, kv, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, kv, ki, t, g=group, n=nq:
-                         (bi, kv * g + t // n, t % n, 0)),
+                         (bi, kv * g + t // n,
+                          _clamp_q(t % n, ki, block_q, block_k, causal),
+                          0)),
             pl.BlockSpec((1, 1, block_q, 1),
                          lambda bi, kv, ki, t, g=group, n=nq:
-                         (bi, kv * g + t // n, t % n, 0)),
+                         (bi, kv * g + t // n,
+                          _clamp_q(t % n, ki, block_q, block_k, causal),
+                          0)),
             pl.BlockSpec((1, 1, block_q, 1),
                          lambda bi, kv, ki, t, g=group, n=nq:
-                         (bi, kv * g + t // n, t % n, 0)),
+                         (bi, kv * g + t // n,
+                          _clamp_q(t % n, ki, block_q, block_k, causal),
+                          0)),
         ],
         out_specs=(pl.BlockSpec((1, 1, block_k, d),
                                 lambda bi, kv, ki, t: (bi, kv, ki, 0)),
@@ -386,9 +442,13 @@ def _flash_backward(q, k, v, out, lse, do, scale: float, causal: bool,
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, kj, g=group: (bi, hi // g, kj, 0)),
+                         lambda bi, hi, qi, kj, g=group:
+                         (bi, hi // g,
+                          _clamp_kv(kj, qi, block_q, block_k, causal), 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, kj, g=group: (bi, hi // g, kj, 0)),
+                         lambda bi, hi, qi, kj, g=group:
+                         (bi, hi // g,
+                          _clamp_kv(kj, qi, block_q, block_k, causal), 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1),
