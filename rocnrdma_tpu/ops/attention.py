@@ -24,7 +24,6 @@ no partitioning rule for a bare pallas_call.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +32,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from rocnrdma_tpu.ops import sharding as _sharding
+from rocnrdma_tpu.ops.common import trace_time_knob
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
@@ -491,14 +491,8 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 def _fa_bwd(causal, scale, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     sc = _resolve_scale(scale, q.shape[-1])
-    # NOTE: read at TRACE time — changing it after a train step has
-    # jit-compiled does not switch the already-cached backward.
-    knob = os.environ.get("TDR_FLASH_BWD", "pallas")
-    if knob not in ("pallas", "remat"):
-        raise ValueError(
-            f"TDR_FLASH_BWD={knob!r}: must be 'pallas' (tiled Pallas "
-            "backward, default) or 'remat' (rematerializing XLA "
-            "backward)")
+    knob = trace_time_knob("TDR_FLASH_BWD", ("pallas", "remat"),
+                           "pallas")
     if knob == "remat":
         # Fallback: recompute the reference forward and differentiate
         # it (materializes S² per head — the pre-round-4 behavior).

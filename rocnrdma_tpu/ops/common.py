@@ -1,0 +1,20 @@
+"""Shared plumbing for the Pallas ops."""
+
+from __future__ import annotations
+
+import os
+
+
+def trace_time_knob(name: str, allowed: tuple, default: str) -> str:
+    """Read an env knob that selects a lowering path.
+
+    NOTE: these are read at TRACE time — changing one after a train
+    step has jit-compiled does not switch the already-cached
+    executable. Unknown values raise so a typo can't silently keep the
+    default path.
+    """
+    val = os.environ.get(name, default)
+    if val not in allowed:
+        raise ValueError(
+            f"{name}={val!r}: must be one of {sorted(allowed)}")
+    return val

@@ -1,11 +1,13 @@
-"""Fused RMSNorm — Pallas TPU kernel with an XLA reference path.
+"""Fused RMSNorm — Pallas TPU kernels (fwd + bwd) with XLA references.
 
 The reference repo has no compute at all (it is a transport driver);
 this op belongs to the JAX consumer stack (BASELINE.md config 4's
-Llama training demo). The kernel keeps the row in VMEM, does the
+Llama training demo). The forward keeps the row in VMEM, does the
 mean-square reduction and scale in one pass (f32 accumulation), and
 writes back in the input dtype — one HBM round trip instead of the
-several an unfused chain would cost.
+several an unfused chain would cost. The backward is one kernel too:
+dx is row-local, and dw accumulates across the sequential row-block
+grid in VMEM scratch, so x and g are each read from HBM exactly once.
 """
 
 from __future__ import annotations
@@ -19,6 +21,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from rocnrdma_tpu.ops import sharding as _sharding
+from rocnrdma_tpu.ops.common import trace_time_knob
 
 _BLOCK_ROWS = 256
 
@@ -70,8 +73,9 @@ def _rmsnorm_cvjp(x, w, eps: float, use_pallas: bool, interpret: bool):
 def rmsnorm(x, w, eps: float = 1e-5, use_pallas: bool = True,
             interpret: bool = False):
     """RMSNorm over the last axis. ``use_pallas`` selects the fused
-    kernel for the forward pass; the backward pass is XLA (cheap and
-    fully fused by the compiler anyway).
+    kernels for BOTH passes — the backward is a single Pallas kernel
+    producing row-local dx and accumulating dw across row blocks in
+    VMEM (``TDR_RMSNORM_BWD=xla`` falls back to the XLA formulas).
 
     Under an active :func:`ops.sharding.pallas_sharding` context the
     kernel shard_maps over the mesh's batch axis (rows are
@@ -97,24 +101,97 @@ def rmsnorm(x, w, eps: float = 1e-5, use_pallas: bool = True,
         lambda x_, w_: rmsnorm_reference(x_, w_, eps))
 
 
+def _bwd_math(x, g, w, eps: float):
+    """The backward formulas in f32, shared by the Pallas kernel and
+    the XLA fallback so the two paths cannot diverge: returns
+    (dx, g∘x̂); dw is the row-sum of the latter.
+
+    d(x·rstd)/dx: rstd · (g·w − x̂ · mean(g·w ∘ x̂)) — the second term
+    is the projection from differentiating rsqrt(mean(x²)).
+    """
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    xhat = x * rstd
+    gw = g * w
+    dx = rstd * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    return dx, g * xhat
+
+
+def _rmsnorm_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dw_ref, dw_acc, *,
+                        eps: float, block: int, total_rows: int):
+    """One row block of the backward: dx is row-local; dw accumulates
+    across the (sequential) grid in VMEM scratch and is written once
+    at the last block. Rows past ``total_rows`` (the last block's
+    out-of-bounds tail) carry undefined values — their dx writes are
+    clipped by Pallas, but they MUST be masked out of the dw sum."""
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_acc[:] = jnp.zeros_like(dw_acc)
+
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)          # (1, d)
+    dx, gxhat = _bwd_math(x, g, w, eps)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    row = i * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
+    contrib = jnp.where(row < total_rows, gxhat, 0.0)
+    dw_acc[:] += jnp.sum(contrib, axis=0, keepdims=True)
+
+    @pl.when(i == n - 1)
+    def _finish():
+        dw_ref[:] = dw_acc[:].astype(dw_ref.dtype)
+
+
+def _rmsnorm_bwd_pallas(x2d, w, g2d, eps: float, interpret: bool):
+    rows, d = x2d.shape
+    block = min(_BLOCK_ROWS, rows)
+    # The row-block walk must be sequential: dw accumulates across it.
+    grid = (pl.cdiv(rows, block),)
+    dx, dw = pl.pallas_call(
+        functools.partial(_rmsnorm_bwd_kernel, eps=eps, block=block,
+                          total_rows=rows),
+        out_shape=(jax.ShapeDtypeStruct((rows, d), x2d.dtype),
+                   jax.ShapeDtypeStruct((1, d), jnp.float32)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(pl.BlockSpec((block, d), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, d), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM)),
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(x2d, w.reshape(1, d), g2d)
+    return dx, dw[0]
+
+
 def _rmsnorm_fwd(x, w, eps, use_pallas, interpret):
     return _rmsnorm_cvjp(x, w, eps, use_pallas, interpret), (x, w)
 
 
 def _rmsnorm_bwd(eps, use_pallas, interpret, res, g):
     x, w = res
-    xf = x.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    wf = w.astype(jnp.float32)
-    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    rstd = jax.lax.rsqrt(ms + eps)
-    xhat = xf * rstd
-    gw = gf * wf
+    knob = trace_time_knob("TDR_RMSNORM_BWD", ("pallas", "xla"), "pallas")
     d = x.shape[-1]
-    # d(x*rstd)/dx: rstd * (g*w − x̂ · mean(g*w · x̂)) — the second term
-    # is the projection from differentiating rsqrt(mean(x²)).
-    dx = rstd * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
-    dw = jnp.sum((gf * xhat).reshape(-1, d), axis=0)
+    if use_pallas and knob == "pallas":
+        dx2d, dw = _rmsnorm_bwd_pallas(
+            x.reshape(-1, d), w, g.reshape(-1, d), eps, interpret)
+        return dx2d.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype)
+    dx, gxhat = _bwd_math(x.astype(jnp.float32), g.astype(jnp.float32),
+                          w.astype(jnp.float32), eps)
+    dw = jnp.sum(gxhat.reshape(-1, d), axis=0)
     return dx.astype(x.dtype), dw.astype(w.dtype)
 
 

@@ -210,3 +210,29 @@ def test_flash_attention_causal_fetch_skip_parity():
         for a, b in zip(gp, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-3)
+
+
+def test_rmsnorm_pallas_backward_parity(monkeypatch):
+    """The fused rmsnorm backward kernel (row-local dx, dw accumulated
+    across the sequential row-block grid) must match the XLA backward
+    formulas — multi-block rows so the dw accumulation is exercised,
+    and 3-D input so the reshape plumbing is covered."""
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    x = jax.random.normal(ks[0], (4, 160, 128))  # 640 rows > _BLOCK_ROWS
+    w = jax.random.normal(ks[1], (128,)) + 1.0
+    g = jax.random.normal(ks[2], (4, 160, 128))
+
+    def f(x, w):
+        return jnp.vdot(rmsnorm(x, w, use_pallas=True, interpret=True), g)
+
+    gx_p, gw_p = jax.grad(f, argnums=(0, 1))(x, w)
+    monkeypatch.setenv("TDR_RMSNORM_BWD", "xla")
+    gx_x, gw_x = jax.grad(f, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx_p), np.asarray(gx_x),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_p), np.asarray(gw_x),
+                               rtol=1e-4, atol=1e-4)
+
+    monkeypatch.setenv("TDR_RMSNORM_BWD", "bogus")
+    with pytest.raises(ValueError, match="TDR_RMSNORM_BWD"):
+        jax.grad(f)(x, w)
