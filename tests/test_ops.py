@@ -225,6 +225,9 @@ def test_rmsnorm_pallas_backward_parity(monkeypatch):
     def f(x, w):
         return jnp.vdot(rmsnorm(x, w, use_pallas=True, interpret=True), g)
 
+    # Pin the knob: an ambient TDR_RMSNORM_BWD=xla would make the
+    # "pallas" side take the XLA path and the parity check vacuous.
+    monkeypatch.setenv("TDR_RMSNORM_BWD", "pallas")
     gx_p, gw_p = jax.grad(f, argnums=(0, 1))(x, w)
     monkeypatch.setenv("TDR_RMSNORM_BWD", "xla")
     gx_x, gw_x = jax.grad(f, argnums=(0, 1))(x, w)

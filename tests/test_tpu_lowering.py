@@ -134,3 +134,15 @@ def test_shard_map_pallas_kernels_lower_for_tpu_mesh():
                     in_shardings=(xspec, NamedSharding(mesh, P()))),
             platforms=["tpu"])(x, w)
     assert "tpu_custom_call" in exp.mlir_module()
+
+    # ... and the rmsnorm BACKWARD kernel under the same mesh (its
+    # per-device row counts and manual axes are a distinct Mosaic
+    # configuration from the unsharded grad export above).
+    with pallas_sharding(mesh):
+        exp = export.export(
+            jax.jit(jax.grad(
+                lambda x, w: rmsnorm(x, w, use_pallas=True)
+                .astype(jnp.float32).sum(), argnums=(0, 1)),
+                in_shardings=(xspec, NamedSharding(mesh, P()))),
+            platforms=["tpu"])(x, w)
+    assert "tpu_custom_call" in exp.mlir_module()
