@@ -1,0 +1,164 @@
+"""Ring attention — sequence-parallel attention over the RDMA transport.
+
+Long-context scaling for the consumer stack: the sequence is sharded
+contiguously across ranks (slices); each rank keeps its Q shard
+resident and the K/V shards ROTATE around the ring over this
+framework's transport — the same QPs, MRs, and front-loaded
+registration the gradient allreduce rides (the reference's invariant:
+all mapping work at registration time, the steady state posts work
+requests only, amdp2p.c:219-264). After world-1 rotations every rank
+has attended its queries against the full sequence without any rank
+ever materializing more than one K/V shard of remote context.
+
+Partial results over disjoint kv shards merge EXACTLY via their
+log-sum-exps (``flash_attention_lse``): for normalized partials
+(out_a, lse_a), (out_b, lse_b),
+
+    out = (out_a·e^{lse_a} + out_b·e^{lse_b}) / (e^{lse_a}+e^{lse_b})
+    lse = logaddexp(lse_a, lse_b)
+
+computed with the running max subtracted for stability — the same
+algebra the flash kernel's online softmax uses across kv blocks,
+lifted to whole shards.
+
+Causality with contiguous sharding is block-triangular: kv shard j
+(global positions before the rank's queries, j < r) is attended in
+full with NO mask; shard j == r uses the ordinary causal kernel;
+shards j > r are skipped outright (their rotation still happens —
+the ring must stay in lockstep).
+
+Scope: forward pass (long-context inference / the attention half of a
+sequence-parallel step). The backward needs the reverse rotation of
+dK/dV partials; it composes from the same exchange primitive and is
+future work.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from rocnrdma_tpu.utils.trace import trace
+
+# wr_id tag space for the rotation ('RA'): distinct from the ring
+# allreduce ('RE'/'SE' << 48) and the schedule digest ids, so ring
+# attention can share the world's QPs with other collectives.
+_WR_RA_RECV = 0x5241 << 48
+_WR_RA_SEND = 0x5253 << 48
+
+
+class RingAttention:
+    """Sequence-parallel flash attention over a :class:`RingWorld`.
+
+    Buffers are registered once (sized to the first call's shard) and
+    reused; each rotation posts one recv + one send on the world's
+    left/right QPs and swaps which buffer is "current" — steady-state
+    cost is work-request posting only.
+    """
+
+    def __init__(self, world, interpret: bool = False,
+                 timeout_ms: int = 30000):
+        self.world = world
+        self.interpret = interpret
+        self.timeout_ms = timeout_ms
+        self._bufs: Optional[list] = None
+        self._mrs: Optional[list] = None
+        self._nbytes = 0
+
+    def _ensure_buffers(self, nbytes: int) -> None:
+        if self._bufs is not None and nbytes == self._nbytes:
+            return
+        self.close()
+        self._bufs = [np.empty(nbytes, dtype=np.uint8) for _ in range(2)]
+        self._mrs = [self.world.engine.reg_mr(b) for b in self._bufs]
+        self._nbytes = nbytes
+
+    def close(self) -> None:
+        if self._mrs is not None:
+            for mr in self._mrs:
+                mr.deregister()
+        self._bufs = None
+        self._mrs = None
+        self._nbytes = 0
+
+    def _rotate(self, cur: int, step: int) -> int:
+        """Send buffer ``cur`` rightward, receive the neighbor's into
+        the other buffer; returns the new current index."""
+        w = self.world
+        nxt = 1 - cur
+        w.left_qp.post_recv(self._mrs[nxt], 0, self._nbytes,
+                            wr_id=_WR_RA_RECV | step)
+        w.right_qp.post_send(self._mrs[cur], 0, self._nbytes,
+                             wr_id=_WR_RA_SEND | step)
+        from rocnrdma_tpu.transport.engine import TransportError
+
+        if not w.right_qp.wait(_WR_RA_SEND | step,
+                               timeout_ms=self.timeout_ms).ok:
+            raise TransportError(f"ring-attention send failed @step {step}")
+        wc = w.left_qp.wait(_WR_RA_RECV | step, timeout_ms=self.timeout_ms)
+        if not wc.ok:
+            raise TransportError(f"ring-attention recv failed @step {step}")
+        if wc.length != self._nbytes:
+            # Unequal per-rank shards: reshaping a short payload plus
+            # stale tail bytes would be silent corruption — fail loud.
+            raise TransportError(
+                f"ring-attention shard mismatch @step {step}: received "
+                f"{wc.length} bytes, expected {self._nbytes} — all "
+                "ranks must hold equally-sized contiguous shards")
+        return nxt
+
+    def __call__(self, q, k, v, causal: bool = True):
+        """q: (B, H, S_local, D); k/v: (B, KVH, S_local, D) — this
+        rank's contiguous shards. Returns this rank's (B, H, S_local,
+        D) output attending the FULL global sequence."""
+        import jax.numpy as jnp
+
+        from rocnrdma_tpu.ops.attention import flash_attention_lse
+
+        q = jnp.asarray(q)
+        k = jnp.asarray(k)
+        v = jnp.asarray(v)
+        rank, world = self.world.rank, self.world.world
+        kv_dtype = np.dtype(k.dtype)
+        k_host = np.ascontiguousarray(np.asarray(k))
+        v_host = np.ascontiguousarray(np.asarray(v))
+        kv_bytes = k_host.nbytes + v_host.nbytes
+        self._ensure_buffers(kv_bytes)
+        buf = self._bufs[0]
+        buf[:k_host.nbytes] = k_host.view(np.uint8).ravel()
+        buf[k_host.nbytes:] = v_host.view(np.uint8).ravel()
+        cur = 0
+
+        def shard_kv(idx: int):
+            # Zero extra host copies: reinterpret the recv buffer in
+            # place (jnp.asarray makes the one unavoidable copy).
+            raw = self._bufs[idx]
+            ks = raw[:k_host.nbytes].view(kv_dtype).reshape(k_host.shape)
+            vs = raw[k_host.nbytes:].view(kv_dtype).reshape(v_host.shape)
+            return jnp.asarray(ks), jnp.asarray(vs)
+
+        # Local shard: ordinary causal (or full) attention.
+        out, lse = flash_attention_lse(q, k, v, causal,
+                                       interpret=self.interpret)
+        out = out.astype(jnp.float32)
+        used = 1
+        for step in range(1, world):
+            cur = self._rotate(cur, step)
+            j = (rank - step) % world
+            if causal and j > rank:
+                continue  # shard is entirely in this rank's future
+            ks, vs = shard_kv(cur)
+            # Remote past shards are attended IN FULL — the causal
+            # boundary only cuts through the local (diagonal) shard.
+            o_i, l_i = flash_attention_lse(q, ks, vs, False,
+                                           interpret=self.interpret)
+            m = jnp.maximum(lse, l_i)
+            a = jnp.exp(lse - m)
+            b = jnp.exp(l_i - m)
+            out = (out * a + o_i.astype(jnp.float32) * b) / (a + b)
+            lse = m + jnp.log(a + b)
+            used += 1
+        trace.event("ring_attention", rank=rank, world=world,
+                    shards_attended=used, rotations=world - 1)
+        return out.astype(q.dtype)

@@ -469,6 +469,19 @@ def _flash_backward(q, k, v, out, lse, do, scale: float, causal: bool,
     return dq[:, :, :s, :], dk[:, :, :s, :], dv[:, :, :s, :]
 
 
+def flash_attention_lse(q, k, v, causal: bool = True, scale=None,
+                        block_q: int = DEFAULT_BLOCK_Q,
+                        block_k: int = DEFAULT_BLOCK_K,
+                        interpret: bool = False):
+    """Forward only, returning ``(out, lse)`` with lse (B, H, S, 1) in
+    f32 — the primitive ring attention needs: two partial results over
+    disjoint kv shards merge exactly via their log-sum-exps (see
+    ``collectives/ring_attention.py``)."""
+    sc = _resolve_scale(scale, q.shape[-1])
+    return _flash_forward(q, k, v, sc, causal, block_q, block_k,
+                          interpret)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True, scale=None,
                     block_q: int = DEFAULT_BLOCK_Q,

@@ -1,0 +1,124 @@
+"""Ring attention over the transport: exact parity vs full attention.
+
+Each rank holds contiguous Q/K/V sequence shards; K/V rotate around
+the ring over the emulated RDMA transport; the merged per-rank outputs
+must equal the reference attention computed on the full gathered
+sequence (the lse merge is exact, so tolerances are float-level).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from test_transport import free_port
+
+
+def _run_ring(world_size: int, causal: bool, h: int = 2, kvh: int = 2,
+              s_local: int = 32, d: int = 16, dtype=np.float32):
+    import jax.numpy as jnp
+
+    from rocnrdma_tpu.collectives.ring_attention import RingAttention
+    from rocnrdma_tpu.collectives.world import local_worlds
+    from rocnrdma_tpu.ops.attention import attention_reference
+
+    rng = np.random.default_rng(world_size * 10 + causal)
+    S = world_size * s_local
+    q_full = rng.standard_normal((1, h, S, d)).astype(dtype)
+    k_full = rng.standard_normal((1, kvh, S, d)).astype(dtype)
+    v_full = rng.standard_normal((1, kvh, S, d)).astype(dtype)
+
+    worlds = local_worlds(world_size, free_port() + 400)
+    outs = [None] * world_size
+    errs = []
+
+    def run_rank(r):
+        try:
+            ra = RingAttention(worlds[r], interpret=True)
+            sl = slice(r * s_local, (r + 1) * s_local)
+            outs[r] = np.asarray(ra(q_full[:, :, sl], k_full[:, :, sl],
+                                    v_full[:, :, sl], causal=causal))
+            ra.close()
+        except Exception as e:  # noqa: BLE001 — surfaced to the test
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=run_rank, args=(r,))
+          for r in range(world_size)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for w in worlds:
+        w.close()
+    assert not errs, errs
+
+    got = np.concatenate(outs, axis=2).astype(np.float32)
+    want = np.asarray(attention_reference(
+        jnp.asarray(q_full), jnp.asarray(k_full), jnp.asarray(v_full),
+        causal=causal)).astype(np.float32)
+    tol = 2e-2 if np.dtype(dtype).itemsize == 2 else 2e-3
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+def test_ring_attention_world2_causal():
+    _run_ring(2, causal=True)
+
+
+def test_ring_attention_world2_causal_bf16():
+    """The production model dtype: the uint8 pack / in-place view
+    unpack of the staging buffers must round-trip ml_dtypes bfloat16
+    exactly (tolerances widened for bf16 compute)."""
+    import ml_dtypes
+
+    _run_ring(2, causal=True, dtype=ml_dtypes.bfloat16)
+
+
+def test_ring_attention_world2_full():
+    _run_ring(2, causal=False)
+
+
+def test_ring_attention_world3_causal_gqa():
+    """3 ranks, GQA (kvh < h): two rotations, block-triangular causal
+    handling (full past shards, causal diagonal, skipped future)."""
+    _run_ring(3, causal=True, h=4, kvh=2)
+
+
+def test_ring_attention_world3_full_mqa():
+    _run_ring(3, causal=False, h=4, kvh=1)
+
+
+def test_ring_attention_posts_only_work_requests():
+    """Front-loaded registration (the reference invariant): after the
+    first call, a second call registers nothing new — the rotation
+    posts work requests against the same MRs."""
+    from rocnrdma_tpu.collectives.ring_attention import RingAttention
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    rng = np.random.default_rng(0)
+    worlds = local_worlds(2, free_port() + 600)
+    ras = [RingAttention(worlds[r], interpret=True) for r in range(2)]
+    q = rng.standard_normal((1, 2, 2 * 16, 16)).astype(np.float32)
+
+    def call_both():
+        outs = [None, None]
+
+        def go(r):
+            sl = slice(r * 16, (r + 1) * 16)
+            outs[r] = ras[r](q[:, :, sl], q[:, :, sl], q[:, :, sl])
+
+        ts = [threading.Thread(target=go, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return outs
+
+    call_both()
+    mrs_before = [ra._mrs for ra in ras]
+    o2 = call_both()
+    assert all(ra._mrs is m for ra, m in zip(ras, mrs_before))
+    assert all(np.isfinite(np.asarray(o)).all() for o in o2)
+    for ra in ras:
+        ra.close()
+    for w in worlds:
+        w.close()
