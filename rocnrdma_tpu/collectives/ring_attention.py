@@ -27,10 +27,12 @@ full with NO mask; shard j == r uses the ordinary causal kernel;
 shards j > r are skipped outright (their rotation still happens —
 the ring must stay in lockstep).
 
-Scope: forward pass (long-context inference / the attention half of a
-sequence-parallel step). The backward needs the reverse rotation of
-dK/dV partials; it composes from the same exchange primitive and is
-future work.
+Both passes: :meth:`RingAttention.forward` returns (out, lse)
+residuals, and :meth:`RingAttention.backward` produces exact (dq, dk,
+dv) — per (q shard, kv shard) pair the flash backward driven by the
+GLOBAL lse yields that pair's exact share of the full-attention
+gradient, dq sums locally, and dK/dV partials accumulate inside the
+rotating buffer until a full cycle brings each shard's gradient home.
 """
 
 from __future__ import annotations
@@ -82,14 +84,17 @@ class RingAttention:
         self._mrs = None
         self._nbytes = 0
 
-    def _rotate(self, cur: int, step: int) -> int:
-        """Send buffer ``cur`` rightward, receive the neighbor's into
-        the other buffer; returns the new current index."""
+    def _rotate(self, cur: int, step: int, nbytes: int) -> int:
+        """Send ``nbytes`` of buffer ``cur`` rightward, receive the
+        neighbor's into the other buffer; returns the new current
+        index. ``nbytes`` is the payload for THIS pass (kv only in
+        forward, kv+grad accumulators in backward) — the buffers are
+        registered once at full capacity."""
         w = self.world
         nxt = 1 - cur
-        w.left_qp.post_recv(self._mrs[nxt], 0, self._nbytes,
+        w.left_qp.post_recv(self._mrs[nxt], 0, nbytes,
                             wr_id=_WR_RA_RECV | step)
-        w.right_qp.post_send(self._mrs[cur], 0, self._nbytes,
+        w.right_qp.post_send(self._mrs[cur], 0, nbytes,
                              wr_id=_WR_RA_SEND | step)
         from rocnrdma_tpu.transport.engine import TransportError
 
@@ -99,19 +104,36 @@ class RingAttention:
         wc = w.left_qp.wait(_WR_RA_RECV | step, timeout_ms=self.timeout_ms)
         if not wc.ok:
             raise TransportError(f"ring-attention recv failed @step {step}")
-        if wc.length != self._nbytes:
+        if wc.length != nbytes:
             # Unequal per-rank shards: reshaping a short payload plus
             # stale tail bytes would be silent corruption — fail loud.
             raise TransportError(
                 f"ring-attention shard mismatch @step {step}: received "
-                f"{wc.length} bytes, expected {self._nbytes} — all "
+                f"{wc.length} bytes, expected {nbytes} — all "
                 "ranks must hold equally-sized contiguous shards")
         return nxt
 
-    def __call__(self, q, k, v, causal: bool = True):
+    @staticmethod
+    def _capacity(k_host, v_host) -> int:
+        """Registered buffer capacity: the kv payload PLUS the f32
+        dK/dV accumulators the backward rotates — sized here so
+        forward and backward share the same registration (register
+        once, steady state posts work requests only)."""
+        return k_host.nbytes + v_host.nbytes + 2 * (k_host.size * 4)
+
+    def _pack_kv(self, k_host, v_host) -> None:
+        self._ensure_buffers(self._capacity(k_host, v_host))
+        buf = self._bufs[0]
+        buf[:k_host.nbytes] = k_host.view(np.uint8).ravel()
+        buf[k_host.nbytes:k_host.nbytes + v_host.nbytes] = \
+            v_host.view(np.uint8).ravel()
+
+    def forward(self, q, k, v, causal: bool = True):
         """q: (B, H, S_local, D); k/v: (B, KVH, S_local, D) — this
-        rank's contiguous shards. Returns this rank's (B, H, S_local,
-        D) output attending the FULL global sequence."""
+        rank's contiguous shards. Returns ``(out, lse)``: this rank's
+        (B, H, S_local, D) output attending the FULL global sequence,
+        and the merged log-sum-exp (B, H, S_local, 1) — the residual
+        :meth:`backward` needs."""
         import jax.numpy as jnp
 
         from rocnrdma_tpu.ops.attention import flash_attention_lse
@@ -124,18 +146,18 @@ class RingAttention:
         k_host = np.ascontiguousarray(np.asarray(k))
         v_host = np.ascontiguousarray(np.asarray(v))
         kv_bytes = k_host.nbytes + v_host.nbytes
-        self._ensure_buffers(kv_bytes)
-        buf = self._bufs[0]
-        buf[:k_host.nbytes] = k_host.view(np.uint8).ravel()
-        buf[k_host.nbytes:] = v_host.view(np.uint8).ravel()
+        self._pack_kv(k_host, v_host)
         cur = 0
 
         def shard_kv(idx: int):
             # Zero extra host copies: reinterpret the recv buffer in
-            # place (jnp.asarray makes the one unavoidable copy).
+            # place (jnp.asarray makes the one unavoidable copy). The
+            # buffer is capacity-sized (it also carries the backward's
+            # accumulators) — slice the kv payload exactly.
             raw = self._bufs[idx]
             ks = raw[:k_host.nbytes].view(kv_dtype).reshape(k_host.shape)
-            vs = raw[k_host.nbytes:].view(kv_dtype).reshape(v_host.shape)
+            vs = raw[k_host.nbytes:kv_bytes].view(kv_dtype).reshape(
+                v_host.shape)
             return jnp.asarray(ks), jnp.asarray(vs)
 
         # Local shard: ordinary causal (or full) attention.
@@ -144,7 +166,7 @@ class RingAttention:
         out = out.astype(jnp.float32)
         used = 1
         for step in range(1, world):
-            cur = self._rotate(cur, step)
+            cur = self._rotate(cur, step, kv_bytes)
             j = (rank - step) % world
             if causal and j > rank:
                 continue  # shard is entirely in this rank's future
@@ -161,4 +183,71 @@ class RingAttention:
             used += 1
         trace.event("ring_attention", rank=rank, world=world,
                     shards_attended=used, rotations=world - 1)
-        return out.astype(q.dtype)
+        return out.astype(q.dtype), lse
+
+    def __call__(self, q, k, v, causal: bool = True):
+        """Forward only; see :meth:`forward` for the residual form."""
+        out, _ = self.forward(q, k, v, causal)
+        return out
+
+    def backward(self, q, k, v, out, lse, do, causal: bool = True):
+        """(dq, dk, dv) for this rank's shards, given the forward's
+        ``(out, lse)`` residuals and the local output cotangent ``do``.
+
+        The exact-gradient identity: with the GLOBAL lse (and delta =
+        rowsum(dO∘out), computed inside the kernel), each (q shard,
+        kv shard) pair's flash backward yields that pair's exact share
+        of the full-attention gradient — dq sums locally over visited
+        shards, while dK/dV partials ACCUMULATE INTO the rotating
+        buffer alongside the kv shard itself, arriving home after a
+        full cycle of ``world`` rotations.
+        """
+        import jax.numpy as jnp
+
+        from rocnrdma_tpu.ops.attention import flash_attention_shard_grads
+
+        q = jnp.asarray(q)
+        do = jnp.asarray(do)
+        out = jnp.asarray(out)
+        lse = jnp.asarray(lse)
+        rank, world = self.world.rank, self.world.world
+        kv_dtype = np.dtype(np.asarray(k).dtype)
+        k_host = np.ascontiguousarray(np.asarray(k))
+        v_host = np.ascontiguousarray(np.asarray(v))
+        kv_bytes = k_host.nbytes + v_host.nbytes
+        # dK/dV partials travel WITH their shard, in f32; the payload
+        # spans the full registered capacity on this pass.
+        full_bytes = self._capacity(k_host, v_host)
+        self._pack_kv(k_host, v_host)
+        self._bufs[0][kv_bytes:] = 0  # zeroed accumulators
+        cur = 0
+        dq = jnp.zeros(q.shape, jnp.float32)
+
+        for step in range(world):
+            j = (rank - step) % world
+            if not (causal and j > rank):
+                raw = self._bufs[cur]
+                ks = raw[:k_host.nbytes].view(kv_dtype).reshape(
+                    k_host.shape)
+                vs = raw[k_host.nbytes:kv_bytes].view(kv_dtype).reshape(
+                    v_host.shape)
+                dq_c, dk_c, dv_c = flash_attention_shard_grads(
+                    q, jnp.asarray(ks), jnp.asarray(vs), out, lse, do,
+                    causal=(causal and j == rank),
+                    interpret=self.interpret)
+                dq = dq + dq_c.astype(jnp.float32)
+                acc = raw[kv_bytes:].view(np.float32).reshape(
+                    (2,) + k_host.shape)
+                acc[0] += np.asarray(dk_c, dtype=np.float32)
+                acc[1] += np.asarray(dv_c, dtype=np.float32)
+            # Rotate even when skipped — and on the LAST step too: the
+            # world-th rotation brings every shard (and its accumulated
+            # grads) home.
+            cur = self._rotate(cur, 0x10000 | step, full_bytes)
+
+        home = self._bufs[cur][kv_bytes:].view(np.float32).reshape(
+            (2,) + k_host.shape)
+        trace.event("ring_attention.bwd", rank=rank, world=world)
+        return (dq.astype(q.dtype),
+                jnp.asarray(home[0]).astype(kv_dtype),
+                jnp.asarray(home[1]).astype(kv_dtype))

@@ -482,6 +482,24 @@ def flash_attention_lse(q, k, v, causal: bool = True, scale=None,
                           interpret)
 
 
+def flash_attention_shard_grads(q, k, v, out, lse, do,
+                                causal: bool = True, scale=None,
+                                block_q: int = DEFAULT_BLOCK_Q,
+                                block_k: int = DEFAULT_BLOCK_K,
+                                interpret: bool = False):
+    """(dq, dk, dv) of one (q shard, kv shard) pair against the
+    GLOBAL softmax: ``out``/``lse`` are the final merged output and
+    log-sum-exp over the full sequence, so p = exp(s − lse) and
+    delta = rowsum(dO∘out) reconstruct each tile's share of the exact
+    full-attention gradient — the identity ring attention's backward
+    is built on (sum over kv shards j of these pair grads = the full
+    gradient). This is the same kernel pair the single-device
+    custom_vjp backward runs."""
+    sc = _resolve_scale(scale, q.shape[-1])
+    return _flash_backward(q, k, v, out, lse, do, sc, causal,
+                           block_q, block_k, interpret)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True, scale=None,
                     block_q: int = DEFAULT_BLOCK_Q,
