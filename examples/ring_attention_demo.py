@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Sequence-parallel ring attention over the RDMA transport.
+
+Each "slice" (thread-rank here; one process per host in production)
+keeps its Q shard resident while K/V shards rotate around the ring on
+the transport's QPs. Forward AND backward: gradients for a shard
+accumulate inside the rotating buffer and arrive home after a full
+cycle. Outputs and gradients are verified against full-sequence
+attention computed in one piece.
+
+Hardware-free run (emulated transport, interpret-mode kernels):
+
+    python examples/ring_attention_demo.py --world 3 --seq-local 64
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--world", type=int, default=3)
+    ap.add_argument("--seq-local", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--head-dim", type=int, default=32)
+    ap.add_argument("--port", type=int, default=25800)
+    args = ap.parse_args()
+
+    from rocnrdma_tpu.utils.hostenv import force_cpu_backend
+    force_cpu_backend()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rocnrdma_tpu.collectives.ring_attention import RingAttention
+    from rocnrdma_tpu.collectives.world import local_worlds
+    from rocnrdma_tpu.ops.attention import attention_reference
+
+    W, sl = args.world, args.seq_local
+    S = W * sl
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((1, args.heads, S, args.head_dim)).astype(
+        np.float32)
+    k = rng.standard_normal((1, args.kv_heads, S, args.head_dim)).astype(
+        np.float32)
+    v = rng.standard_normal((1, args.kv_heads, S, args.head_dim)).astype(
+        np.float32)
+    do = rng.standard_normal(q.shape).astype(np.float32)
+
+    worlds = local_worlds(W, args.port)
+    outs, grads = [None] * W, [None] * W
+
+    def run_rank(r):
+        ra = RingAttention(worlds[r], interpret=True)
+        s_ = slice(r * sl, (r + 1) * sl)
+        out, lse = ra.forward(q[:, :, s_], k[:, :, s_], v[:, :, s_])
+        outs[r] = np.asarray(out)
+        grads[r] = tuple(np.asarray(g) for g in ra.backward(
+            q[:, :, s_], k[:, :, s_], v[:, :, s_], out, lse,
+            do[:, :, s_]))
+        ra.close()
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=run_rank, args=(r,)) for r in range(W)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    for w in worlds:
+        w.close()
+
+    want = np.asarray(attention_reference(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True))
+    got = np.concatenate(outs, axis=2)
+    fwd_err = float(np.max(np.abs(got - want)))
+
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=True),
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    refs = [np.asarray(g) for g in vjp(jnp.asarray(do))]
+    errs = [float(np.max(np.abs(
+        np.concatenate([g[i] for g in grads], axis=2) - refs[i])))
+        for i in range(3)]
+
+    print(f"world={W} seq={S} ({sl}/rank) heads={args.heads} "
+          f"kv={args.kv_heads} d={args.head_dim}")
+    print(f"fwd+bwd wall {dt:.2f}s; {2 * W - 1} rotations/rank "
+          "over the transport (W-1 fwd + W bwd)")
+    print(f"max |err| vs full-sequence reference: fwd {fwd_err:.2e}, "
+          f"dq {errs[0]:.2e}, dk {errs[1]:.2e}, dv {errs[2]:.2e}")
+    assert fwd_err < 2e-3 and max(errs) < 2e-3
+    print("ring attention fwd+bwd == full attention OK")
+
+
+if __name__ == "__main__":
+    main()

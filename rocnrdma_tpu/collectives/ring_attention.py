@@ -128,6 +128,16 @@ class RingAttention:
         buf[k_host.nbytes:k_host.nbytes + v_host.nbytes] = \
             v_host.view(np.uint8).ravel()
 
+    def _unpack_kv(self, idx: int, k_host, v_host, kv_dtype):
+        """In-place (no-copy) K/V views of buffer ``idx`` — the ONE
+        definition of the packing layout, shared by both passes (the
+        buffer is capacity-sized; kv occupies its leading bytes)."""
+        raw = self._bufs[idx]
+        ks = raw[:k_host.nbytes].view(kv_dtype).reshape(k_host.shape)
+        vs = raw[k_host.nbytes:k_host.nbytes + v_host.nbytes].view(
+            kv_dtype).reshape(v_host.shape)
+        return ks, vs
+
     def forward(self, q, k, v, causal: bool = True):
         """q: (B, H, S_local, D); k/v: (B, KVH, S_local, D) — this
         rank's contiguous shards. Returns ``(out, lse)``: this rank's
@@ -150,14 +160,9 @@ class RingAttention:
         cur = 0
 
         def shard_kv(idx: int):
-            # Zero extra host copies: reinterpret the recv buffer in
-            # place (jnp.asarray makes the one unavoidable copy). The
-            # buffer is capacity-sized (it also carries the backward's
-            # accumulators) — slice the kv payload exactly.
-            raw = self._bufs[idx]
-            ks = raw[:k_host.nbytes].view(kv_dtype).reshape(k_host.shape)
-            vs = raw[k_host.nbytes:kv_bytes].view(kv_dtype).reshape(
-                v_host.shape)
+            # jnp.asarray makes the one unavoidable copy of the
+            # in-place views.
+            ks, vs = self._unpack_kv(idx, k_host, v_host, kv_dtype)
             return jnp.asarray(ks), jnp.asarray(vs)
 
         # Local shard: ordinary causal (or full) attention.
@@ -226,11 +231,8 @@ class RingAttention:
         for step in range(world):
             j = (rank - step) % world
             if not (causal and j > rank):
+                ks, vs = self._unpack_kv(cur, k_host, v_host, kv_dtype)
                 raw = self._bufs[cur]
-                ks = raw[:k_host.nbytes].view(kv_dtype).reshape(
-                    k_host.shape)
-                vs = raw[k_host.nbytes:kv_bytes].view(kv_dtype).reshape(
-                    v_host.shape)
                 dq_c, dk_c, dv_c = flash_attention_shard_grads(
                     q, jnp.asarray(ks), jnp.asarray(vs), out, lse, do,
                     causal=(causal and j == rank),

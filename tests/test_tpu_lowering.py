@@ -146,3 +146,16 @@ def test_shard_map_pallas_kernels_lower_for_tpu_mesh():
                 in_shardings=(xspec, NamedSharding(mesh, P()))),
             platforms=["tpu"])(x, w)
     assert "tpu_custom_call" in exp.mlir_module()
+
+
+def test_flash_attention_lse_lowers_for_tpu():
+    """The two-output (out, lse) forward — the primitive ring
+    attention merges on — cross-lowers with both outputs live (the
+    single-output path may DCE the lse write; this one cannot)."""
+    from rocnrdma_tpu.ops.attention import flash_attention_lse
+
+    def f(q, k, v):
+        out, lse = flash_attention_lse(q, k, v, True)
+        return out, lse
+
+    export.export(jax.jit(f), platforms=["tpu"])(Q, KV, KV)
