@@ -19,7 +19,12 @@ Carried alongside, so the headline number is judgeable:
   least once — the allreduce cannot beat the fold rate.
   ``vs_roofline`` = headline / fold-roofline is the fraction of what
   this machine physically allows (vs_baseline measures distance to a
-  100 Gb/s NIC this host does not have).
+  100 Gb/s NIC this host does not have). Cross-ROUND absolute
+  comparisons track hypervisor state, not code: an A/B on identical
+  idle conditions (2026-07-30) measured the round-3 snapshot's
+  binary at 4.17 GB/s where the round-4 binary did 5.37 — the code
+  got ~28% faster while the recorded round-3 headline (6.83,
+  measured on a faster day) sits above both.
 - **Point-to-point**: ib_write_bw-style loopback (config 0) plus the
   config-2 4 B–1 GiB message sweep (peak + small-message latency).
 - **Real-TPU sub-benches** when the device tunnel is reachable:
