@@ -24,8 +24,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ATTEMPTS = os.path.join(REPO, "TPU_ATTEMPTS_r04.jsonl")
-RESULTS = os.path.join(REPO, "TPU_RESULTS_r04_extra.json")
+ROUND = os.environ.get("TDR_ROUND", "r05")
+ATTEMPTS = os.path.join(REPO, f"TPU_ATTEMPTS_{ROUND}.jsonl")
+RESULTS = os.path.join(REPO, f"TPU_RESULTS_{ROUND}_extra.json")
 
 BENCH = r"""
 import functools, json, time, sys
@@ -257,14 +258,25 @@ def main():
     with open(ATTEMPTS, "a") as f:
         f.write(json.dumps(rec) + "\n")
     if results is not None:
+        # Carry the completed-section count in the bank itself so the
+        # richness comparison below counts sections, not dict keys
+        # (keys shift when the bench script restructures its output).
+        results["_steps"] = rec.get("steps", 0)
         # Never let a degraded run clobber better banked evidence: a
-        # partial (or any) result only replaces an existing file if it
-        # completed at least as many sections.
+        # COMPLETE previous file always beats a partial new result
+        # (a partial that finished every section still gains a
+        # "partial" key and could out-count a clean run), and among
+        # equals, keep whichever completed more sections.
         if os.path.exists(RESULTS):
             try:
                 with open(RESULTS) as f:
                     prev = json.load(f)
-                if len(results) < len(prev):
+                prev_complete = "partial" not in prev
+                new_complete = "partial" not in results
+                if (prev_complete and not new_complete) or (
+                        prev_complete == new_complete
+                        and results["_steps"] < prev.get(
+                            "_steps", len(prev))):
                     print("kept existing richer", RESULTS)
                     return 0 if rec.get("ok") else 1
             except Exception:  # noqa: BLE001 — unreadable prev: replace

@@ -1,0 +1,16 @@
+#!/bin/sh
+# Round-long TPU chase driver: loop the core bench until the tunnel
+# answers (tpu_chase banks TPU_RESULTS_r05.json and exits 0), then run
+# the deep kernel measurements (tpu_extra). If the tunnel dies between
+# the two, go back to chasing. Every attempt is logged to
+# TPU_ATTEMPTS_r05.jsonl either way.
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  python tools/tpu_chase.py || exit 1   # loops internally until banked
+  if python tools/tpu_extra.py; then
+    echo "tpu_session: both banked, done"
+    exit 0
+  fi
+  echo "tpu_session: extra failed after chase success; re-chasing in 300s"
+  sleep 300
+done
