@@ -132,9 +132,46 @@ class RMSNorm(nn.Module):
 
 
 class Attention(nn.Module):
+    """Attention sub-block, ``setup()``-style so the projections are
+    addressable as methods: the sequence-parallel path
+    (``parallel/seq_parallel.py``) drives :meth:`qkv` →
+    transport-rotated ring attention → :meth:`out_proj` layerwise,
+    against the SAME parameters and math the fused ``__call__`` uses."""
+
     cfg: LlamaConfig
 
-    @nn.compact
+    def setup(self):
+        cfg = self.cfg
+        dense = lambda feats: nn.Dense(
+            feats, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.dtype)
+        hd = cfg.head_dim
+        self.wq = dense(cfg.n_heads * hd)
+        self.wk = dense(cfg.n_kv_heads * hd)
+        self.wv = dense(cfg.n_kv_heads * hd)
+        self.wo = dense(cfg.d_model)
+
+    def qkv(self, x, freqs):
+        """(B, S, D) normed input → roped (q, k, v) in (B, H, S, hd) /
+        (B, KVH, S, hd) layout. ``freqs`` must already be sliced to
+        x's absolute positions — the seq-parallel caller passes its
+        shard's slice, the local path passes ``freqs[:s]``."""
+        cfg = self.cfg
+        b, s, _ = x.shape
+        hd = cfg.head_dim
+        q = self.wq(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = self.wk(x).reshape(b, s, cfg.n_kv_heads, hd).transpose(
+            0, 2, 1, 3)
+        v = self.wv(x).reshape(b, s, cfg.n_kv_heads, hd).transpose(
+            0, 2, 1, 3)
+        return apply_rope(q, freqs), apply_rope(k, freqs), v
+
+    def out_proj(self, o):
+        """(B, H, S, hd) attention output → (B, S, D) projection."""
+        b, _, s, _ = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(
+            b, s, self.cfg.n_heads * self.cfg.head_dim)
+        return self.wo(o)
+
     def __call__(self, x, freqs, cache=None, pos=None):
         """Training/no-cache: x is the full (B, S, D) sequence, causal
         attention, returns (out, None). Decode: ``cache`` holds per-
@@ -145,24 +182,18 @@ class Attention(nn.Module):
         cfg = self.cfg
         b, s, _ = x.shape
         hd = cfg.head_dim
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=False, dtype=cfg.dtype,
-            param_dtype=cfg.dtype, name=name)
-        q = dense(cfg.n_heads * hd, "wq")(x)
-        k = dense(cfg.n_kv_heads * hd, "wk")(x)
-        v = dense(cfg.n_kv_heads * hd, "wv")(x)
-        q = q.reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-        k = k.reshape(b, s, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(b, s, cfg.n_kv_heads, hd).transpose(0, 2, 1, 3)
         if cache is None:
-            q = apply_rope(q, freqs[:s])
-            k = apply_rope(k, freqs[:s])
+            q, k, v = self.qkv(x, freqs[:s])
             o = attention(q, k, v, causal=True,
                           use_pallas=resolve_pallas(cfg.use_pallas_attention),
                           interpret=cfg.pallas_interpret)
-            o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
-            return dense(cfg.d_model, "wo")(o), None
+            return self.out_proj(o), None
 
+        q = self.wq(x).reshape(b, s, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+        k = self.wk(x).reshape(b, s, cfg.n_kv_heads, hd).transpose(
+            0, 2, 1, 3)
+        v = self.wv(x).reshape(b, s, cfg.n_kv_heads, hd).transpose(
+            0, 2, 1, 3)
         fr = jax.lax.dynamic_slice_in_dim(freqs, pos, s)
         q = apply_rope(q, fr)
         k = apply_rope(k, fr)
@@ -187,8 +218,7 @@ class Attention(nn.Module):
         o = jnp.einsum("bgrqk,bgkd->bgrqd", probs.astype(cfg.dtype), v_all,
                        preferred_element_type=jnp.float32)
         o = o.astype(cfg.dtype).reshape(b, cfg.n_heads, s, hd)
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * hd)
-        return dense(cfg.d_model, "wo")(o), {"k": k_all, "v": v_all}
+        return self.out_proj(o), {"k": k_all, "v": v_all}
 
 
 class MLP(nn.Module):
@@ -206,15 +236,38 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
+    """Transformer block. ``setup()``-style: besides the fused
+    ``__call__``, exposes the attention-split halves the
+    sequence-parallel runner drives — :meth:`qkv` (norm + projections +
+    rope, everything before the attention contraction) and :meth:`post`
+    (output projection + residuals + MLP, everything after). The
+    fused path and the split path share every parameter and every op,
+    so parity between them is structural, not coincidental."""
+
     cfg: LlamaConfig
 
-    @nn.compact
+    def setup(self):
+        self.attn_norm = RMSNorm(self.cfg)
+        self.attn = Attention(self.cfg)
+        self.mlp_norm = RMSNorm(self.cfg)
+        self.mlp = MLP(self.cfg)
+
+    def qkv(self, x, freqs):
+        """Pre-attention half for the seq-parallel runner: ``freqs``
+        sliced to x's absolute positions."""
+        return self.attn.qkv(self.attn_norm(x), freqs)
+
+    def post(self, x, o):
+        """Post-attention half: ``o`` is the (B, H, S_local, hd)
+        attention output for this rank's queries."""
+        y = x + self.attn.out_proj(o)
+        return y + self.mlp(self.mlp_norm(y))
+
     def __call__(self, x, freqs, cache=None, pos=None):
-        attn_out, new_cache = Attention(self.cfg, name="attn")(
-            RMSNorm(self.cfg, name="attn_norm")(x), freqs, cache, pos)
+        attn_out, new_cache = self.attn(self.attn_norm(x), freqs, cache,
+                                        pos)
         x = x + attn_out
-        x = x + MLP(self.cfg, name="mlp")(
-            RMSNorm(self.cfg, name="mlp_norm")(x))
+        x = x + self.mlp(self.mlp_norm(x))
         return x, new_cache
 
 
