@@ -27,27 +27,62 @@ full with NO mask; shard j == r uses the ordinary causal kernel;
 shards j > r are skipped outright (their rotation still happens —
 the ring must stay in lockstep).
 
+**Comm/compute overlap** (the classic ring-attention schedule): the
+rotation for shard j+1 is posted BEFORE computing on shard j, double-
+buffered, so the wire transfer hides behind the attention kernel. The
+backward splits the payload into two channels — the K/V shard (pure
+data, prefetched exactly like the forward) and the dK/dV accumulator
+(produced by the compute, so its rotation necessarily trails by one
+step and overlaps the NEXT shard's gradient kernel instead). Set
+``TDR_RA_NO_OVERLAP=1`` for the strictly-serial schedule (rotate, then
+compute) — the A/B the overlap bench measures against. Time blocked in
+transport waits is recorded per call (``last_wait_s`` vs
+``last_total_s``) so the hidden fraction is measurable, and every
+host bounce (D2H of K/V, H2D of received shards and homecoming
+gradients) is charged to ``collectives.staging``.
+
 Both passes: :meth:`RingAttention.forward` returns (out, lse)
 residuals, and :meth:`RingAttention.backward` produces exact (dq, dk,
 dv) — per (q shard, kv shard) pair the flash backward driven by the
 GLOBAL lse yields that pair's exact share of the full-attention
 gradient, dq sums locally, and dK/dV partials accumulate inside the
-rotating buffer until a full cycle brings each shard's gradient home.
+rotating accumulator until a full cycle brings each shard's gradient
+home.
+
+Concurrency contract: ONE collective at a time per world (the same
+contract the ring allreduce has — both share the world's QPs). A
+per-call nonce is mixed into the wr_id tag bits so sequential calls —
+including a forward interleaved with a later backward, or two
+RingAttention instances used alternately on one world — can never
+collide on stale completions; genuinely concurrent calls on one world
+remain unsupported.
 """
 
 from __future__ import annotations
 
+import itertools
+import os
+import time
 from typing import Optional
 
 import numpy as np
 
+from rocnrdma_tpu.collectives.staging import staging
 from rocnrdma_tpu.utils.trace import trace
 
 # wr_id tag space for the rotation ('RA'): distinct from the ring
 # allreduce ('RE'/'SE' << 48) and the schedule digest ids, so ring
 # attention can share the world's QPs with other collectives.
+# Layout below the 16-bit marker: [12-bit nonce @ bit 36]
+# [2-bit channel @ bit 34][34-bit step @ bit 0].
 _WR_RA_RECV = 0x5241 << 48
 _WR_RA_SEND = 0x5253 << 48
+_CH_KV = 0
+_CH_ACC = 1
+
+# Per-process nonce source shared by all instances: two RingAttention
+# objects alternating on ONE world must still get distinct tags.
+_NONCE = itertools.count(1)
 
 
 class RingAttention:
@@ -67,6 +102,13 @@ class RingAttention:
         self._bufs: Optional[list] = None
         self._mrs: Optional[list] = None
         self._nbytes = 0
+        self._tag = 0  # current call's nonce-derived tag bits
+        # Wait-time accounting for the overlap bench: seconds blocked
+        # in transport waits vs the whole pass, for the LAST call.
+        self.last_wait_s = 0.0
+        self.last_total_s = 0.0
+
+    # ------------------------------------------------------- plumbing
 
     def _ensure_buffers(self, nbytes: int) -> None:
         if self._bufs is not None and nbytes == self._nbytes:
@@ -84,42 +126,71 @@ class RingAttention:
         self._mrs = None
         self._nbytes = 0
 
-    def _rotate(self, cur: int, step: int, nbytes: int) -> int:
-        """Send ``nbytes`` of buffer ``cur`` rightward, receive the
-        neighbor's into the other buffer; returns the new current
-        index. ``nbytes`` is the payload for THIS pass (kv only in
-        forward, kv+grad accumulators in backward) — the buffers are
-        registered once at full capacity."""
+    def _new_call(self) -> None:
+        """Fresh per-call tag bits (see the concurrency contract in
+        the module docstring) and wait-clock reset."""
+        self._tag = (next(_NONCE) & 0xFFF) << 36
+        self.last_wait_s = 0.0
+
+    def _wrid(self, base: int, ch: int, step: int) -> int:
+        return base | self._tag | (ch << 34) | step
+
+    def _post_rot(self, ch: int, step: int, cur: int, off: int,
+                  nbytes: int) -> None:
+        """Post one rotation on channel ``ch``: send ``nbytes`` at
+        ``off`` of buffer ``cur`` rightward, receive the neighbor's
+        into the same region of the other buffer. Returns immediately —
+        :meth:`_wait_rot` collects the completions."""
         w = self.world
-        nxt = 1 - cur
-        w.left_qp.post_recv(self._mrs[nxt], 0, nbytes,
-                            wr_id=_WR_RA_RECV | step)
-        w.right_qp.post_send(self._mrs[cur], 0, nbytes,
-                             wr_id=_WR_RA_SEND | step)
+        w.left_qp.post_recv(self._mrs[1 - cur], off, nbytes,
+                            wr_id=self._wrid(_WR_RA_RECV, ch, step))
+        w.right_qp.post_send(self._mrs[cur], off, nbytes,
+                             wr_id=self._wrid(_WR_RA_SEND, ch, step))
+
+    def _wait_rot(self, ch: int, step: int, nbytes: int) -> None:
         from rocnrdma_tpu.transport.engine import TransportError
 
-        if not w.right_qp.wait(_WR_RA_SEND | step,
+        t0 = time.perf_counter()
+        w = self.world
+        if not w.right_qp.wait(self._wrid(_WR_RA_SEND, ch, step),
                                timeout_ms=self.timeout_ms).ok:
-            raise TransportError(f"ring-attention send failed @step {step}")
-        wc = w.left_qp.wait(_WR_RA_RECV | step, timeout_ms=self.timeout_ms)
+            raise TransportError(
+                f"ring-attention send failed @ch{ch} step {step}")
+        wc = w.left_qp.wait(self._wrid(_WR_RA_RECV, ch, step),
+                            timeout_ms=self.timeout_ms)
         if not wc.ok:
-            raise TransportError(f"ring-attention recv failed @step {step}")
+            raise TransportError(
+                f"ring-attention recv failed @ch{ch} step {step}")
         if wc.length != nbytes:
             # Unequal per-rank shards: reshaping a short payload plus
             # stale tail bytes would be silent corruption — fail loud.
             raise TransportError(
-                f"ring-attention shard mismatch @step {step}: received "
-                f"{wc.length} bytes, expected {nbytes} — all "
+                f"ring-attention shard mismatch @ch{ch} step {step}: "
+                f"received {wc.length} bytes, expected {nbytes} — all "
                 "ranks must hold equally-sized contiguous shards")
-        return nxt
+        self.last_wait_s += time.perf_counter() - t0
 
     @staticmethod
-    def _capacity(k_host, v_host) -> int:
+    def _overlap_enabled() -> bool:
+        return os.environ.get("TDR_RA_NO_OVERLAP", "0") in ("", "0")
+
+    # ---------------------------------------------------- buffer layout
+
+    @staticmethod
+    def _acc_bytes(k_host, v_host) -> int:
+        """f32 dK + dV accumulator region, sized INDEPENDENTLY from k
+        and v (K and V may have different head_dims in some
+        architectures; sizing dV off k.size would mis-size the region
+        and only fail at reshape time)."""
+        return 4 * (k_host.size + v_host.size)
+
+    def _capacity(self, k_host, v_host) -> int:
         """Registered buffer capacity: the kv payload PLUS the f32
         dK/dV accumulators the backward rotates — sized here so
         forward and backward share the same registration (register
         once, steady state posts work requests only)."""
-        return k_host.nbytes + v_host.nbytes + 2 * (k_host.size * 4)
+        return k_host.nbytes + v_host.nbytes + self._acc_bytes(
+            k_host, v_host)
 
     def _pack_kv(self, k_host, v_host) -> None:
         self._ensure_buffers(self._capacity(k_host, v_host))
@@ -138,6 +209,17 @@ class RingAttention:
             kv_dtype).reshape(v_host.shape)
         return ks, vs
 
+    def _acc_views(self, idx: int, kv_bytes: int, k_host, v_host):
+        """(dK, dV) f32 views of buffer ``idx``'s accumulator region."""
+        raw = self._bufs[idx]
+        dk_n = k_host.size
+        acc = raw[kv_bytes:kv_bytes + self._acc_bytes(k_host, v_host)]
+        f32 = acc.view(np.float32)
+        return (f32[:dk_n].reshape(k_host.shape),
+                f32[dk_n:].reshape(v_host.shape))
+
+    # ------------------------------------------------------------ fwd
+
     def forward(self, q, k, v, causal: bool = True):
         """q: (B, H, S_local, D); k/v: (B, KVH, S_local, D) — this
         rank's contiguous shards. Returns ``(out, lse)``: this rank's
@@ -148,22 +230,35 @@ class RingAttention:
 
         from rocnrdma_tpu.ops.attention import flash_attention_lse
 
+        t_start = time.perf_counter()
+        self._new_call()
         q = jnp.asarray(q)
         k = jnp.asarray(k)
         v = jnp.asarray(v)
         rank, world = self.world.rank, self.world.world
         kv_dtype = np.dtype(k.dtype)
+        # D2H bounce of this rank's K/V into the registered rotation
+        # buffer (on a real TPU backend this is a device→host copy —
+        # the staged path's cost, charged as such).
         k_host = np.ascontiguousarray(np.asarray(k))
         v_host = np.ascontiguousarray(np.asarray(v))
         kv_bytes = k_host.nbytes + v_host.nbytes
+        staging.add(kv_bytes)
         self._pack_kv(k_host, v_host)
+        overlap = self._overlap_enabled()
         cur = 0
 
         def shard_kv(idx: int):
-            # jnp.asarray makes the one unavoidable copy of the
-            # in-place views.
+            # H2D bounce: jnp.asarray copies the in-place views onto
+            # the compute device.
             ks, vs = self._unpack_kv(idx, k_host, v_host, kv_dtype)
+            staging.add(kv_bytes)
             return jnp.asarray(ks), jnp.asarray(vs)
+
+        # Prefetch rotation 1 BEFORE the local compute: the first wire
+        # transfer hides behind the local shard's attention kernel.
+        if world > 1 and overlap:
+            self._post_rot(_CH_KV, 1, cur, 0, kv_bytes)
 
         # Local shard: ordinary causal (or full) attention.
         out, lse = flash_attention_lse(q, k, v, causal,
@@ -171,11 +266,22 @@ class RingAttention:
         out = out.astype(jnp.float32)
         used = 1
         for step in range(1, world):
-            cur = self._rotate(cur, step, kv_bytes)
+            if not overlap:
+                self._post_rot(_CH_KV, step, cur, 0, kv_bytes)
+            self._wait_rot(_CH_KV, step, kv_bytes)
+            cur = 1 - cur
             j = (rank - step) % world
-            if causal and j > rank:
+            skip = causal and j > rank
+            if not skip:
+                ks, vs = shard_kv(cur)
+            # Rotation step+1 posts as soon as the received shard is
+            # copied out (or immediately, if this shard is skipped):
+            # the next transfer rides the wire while THIS shard's
+            # kernel runs.
+            if overlap and step + 1 < world:
+                self._post_rot(_CH_KV, step + 1, cur, 0, kv_bytes)
+            if skip:
                 continue  # shard is entirely in this rank's future
-            ks, vs = shard_kv(cur)
             # Remote past shards are attended IN FULL — the causal
             # boundary only cuts through the local (diagonal) shard.
             o_i, l_i = flash_attention_lse(q, ks, vs, False,
@@ -186,14 +292,20 @@ class RingAttention:
             out = (out * a + o_i.astype(jnp.float32) * b) / (a + b)
             lse = m + jnp.log(a + b)
             used += 1
+        self.last_total_s = time.perf_counter() - t_start
         trace.event("ring_attention", rank=rank, world=world,
-                    shards_attended=used, rotations=world - 1)
+                    shards_attended=used, rotations=world - 1,
+                    overlap=int(overlap),
+                    wait_s=round(self.last_wait_s, 6),
+                    total_s=round(self.last_total_s, 6))
         return out.astype(q.dtype), lse
 
     def __call__(self, q, k, v, causal: bool = True):
         """Forward only; see :meth:`forward` for the residual form."""
         out, _ = self.forward(q, k, v, causal)
         return out
+
+    # ------------------------------------------------------------ bwd
 
     def backward(self, q, k, v, out, lse, do, causal: bool = True):
         """(dq, dk, dv) for this rank's shards, given the forward's
@@ -203,14 +315,23 @@ class RingAttention:
         rowsum(dO∘out), computed inside the kernel), each (q shard,
         kv shard) pair's flash backward yields that pair's exact share
         of the full-attention gradient — dq sums locally over visited
-        shards, while dK/dV partials ACCUMULATE INTO the rotating
-        buffer alongside the kv shard itself, arriving home after a
-        full cycle of ``world`` rotations.
+        shards, while dK/dV partials ACCUMULATE in a rotating buffer,
+        arriving home after a full cycle of ``world`` rotations.
+
+        Two channels, overlapped independently: the K/V shard is pure
+        data and prefetches ahead of the compute exactly like the
+        forward (W−1 rotations); the accumulator is PRODUCED by the
+        compute, so its rotation necessarily trails — posted right
+        after each shard's contribution is added, collected just
+        before the NEXT shard's addition, hiding behind that shard's
+        gradient kernel (W rotations; the last one is the homecoming).
         """
         import jax.numpy as jnp
 
         from rocnrdma_tpu.ops.attention import flash_attention_shard_grads
 
+        t_start = time.perf_counter()
+        self._new_call()
         q = jnp.asarray(q)
         do = jnp.asarray(do)
         out = jnp.asarray(out)
@@ -220,36 +341,88 @@ class RingAttention:
         k_host = np.ascontiguousarray(np.asarray(k))
         v_host = np.ascontiguousarray(np.asarray(v))
         kv_bytes = k_host.nbytes + v_host.nbytes
-        # dK/dV partials travel WITH their shard, in f32; the payload
-        # spans the full registered capacity on this pass.
-        full_bytes = self._capacity(k_host, v_host)
+        acc_bytes = self._acc_bytes(k_host, v_host)
+        staging.add(kv_bytes)  # D2H of this rank's K/V
         self._pack_kv(k_host, v_host)
-        self._bufs[0][kv_bytes:] = 0  # zeroed accumulators
-        cur = 0
+        overlap = self._overlap_enabled()
+        # Both buffers' accumulator regions start zeroed: buffer 0
+        # carries the shard-``rank`` accumulator out on the first acc
+        # rotation, buffer 1 receives into a region that must not hold
+        # stale bytes from a previous call.
+        for b in self._bufs:
+            b[kv_bytes:kv_bytes + acc_bytes] = 0
+        kv_cur = 0
+        acc_cur = 0
         dq = jnp.zeros(q.shape, jnp.float32)
+
+        # ks/vs for step 0 are this rank's own (device-resident) k/v —
+        # no unpack needed; remote shards are copied out after each kv
+        # rotation lands.
+        ks, vs = k, v
+        if world > 1 and overlap:
+            self._post_rot(_CH_KV, 1, kv_cur, 0, kv_bytes)
 
         for step in range(world):
             j = (rank - step) % world
-            if not (causal and j > rank):
-                ks, vs = self._unpack_kv(cur, k_host, v_host, kv_dtype)
-                raw = self._bufs[cur]
+            visible = not (causal and j > rank)
+            if visible:
                 dq_c, dk_c, dv_c = flash_attention_shard_grads(
-                    q, jnp.asarray(ks), jnp.asarray(vs), out, lse, do,
+                    q, ks, vs, out, lse, do,
                     causal=(causal and j == rank),
                     interpret=self.interpret)
                 dq = dq + dq_c.astype(jnp.float32)
-                acc = raw[kv_bytes:].view(np.float32).reshape(
-                    (2,) + k_host.shape)
-                acc[0] += np.asarray(dk_c, dtype=np.float32)
-                acc[1] += np.asarray(dv_c, dtype=np.float32)
-            # Rotate even when skipped — and on the LAST step too: the
-            # world-th rotation brings every shard (and its accumulated
-            # grads) home.
-            cur = self._rotate(cur, 0x10000 | step, full_bytes)
+            # Collect the trailing acc rotation (step-1) — the partials
+            # for shard j contributed by the ranks that held it before
+            # us — BEFORE adding our own contribution. In the overlap
+            # schedule this wait sits AFTER this shard's gradient
+            # kernel, which is what hides it. (The serial schedule
+            # already waited at post time.)
+            if overlap and step > 0:
+                self._wait_rot(_CH_ACC, step - 1, acc_bytes)
+                acc_cur = 1 - acc_cur
+            if visible:
+                dk_acc, dv_acc = self._acc_views(acc_cur, kv_bytes,
+                                                 k_host, v_host)
+                # D2H bounce of this pair's dK/dV partials.
+                staging.add(acc_bytes)
+                dk_acc += np.asarray(dk_c, dtype=np.float32)
+                dv_acc += np.asarray(dv_c, dtype=np.float32)
+            # Send the accumulator onward (rank r+1 holds shard j next
+            # step). W rotations total; the last delivers each shard's
+            # summed gradient to its owner.
+            self._post_rot(_CH_ACC, step, acc_cur, kv_bytes, acc_bytes)
+            if not overlap:
+                self._wait_rot(_CH_ACC, step, acc_bytes)
+                acc_cur = 1 - acc_cur
+            # Advance the kv channel for the NEXT step (prefetched in
+            # the overlap schedule; posted-and-waited serially without).
+            if step + 1 < world:
+                if not overlap:
+                    self._post_rot(_CH_KV, step + 1, kv_cur, 0, kv_bytes)
+                self._wait_rot(_CH_KV, step + 1, kv_bytes)
+                kv_cur = 1 - kv_cur
+                nj = (rank - (step + 1)) % world
+                if not (causal and nj > rank):
+                    ks_h, vs_h = self._unpack_kv(kv_cur, k_host, v_host,
+                                                 kv_dtype)
+                    staging.add(kv_bytes)  # H2D of the received shard
+                    ks, vs = jnp.asarray(ks_h), jnp.asarray(vs_h)
+                if overlap and step + 2 < world:
+                    self._post_rot(_CH_KV, step + 2, kv_cur, 0, kv_bytes)
+        if overlap:
+            # The homecoming acc rotation (posted in the last loop
+            # iteration) is the one completion still outstanding.
+            self._wait_rot(_CH_ACC, world - 1, acc_bytes)
+            acc_cur = 1 - acc_cur
 
-        home = self._bufs[cur][kv_bytes:].view(np.float32).reshape(
-            (2,) + k_host.shape)
-        trace.event("ring_attention.bwd", rank=rank, world=world)
+        home_dk, home_dv = self._acc_views(acc_cur, kv_bytes, k_host,
+                                           v_host)
+        staging.add(acc_bytes)  # H2D of the homecoming gradients
+        self.last_total_s = time.perf_counter() - t_start
+        trace.event("ring_attention.bwd", rank=rank, world=world,
+                    overlap=int(overlap),
+                    wait_s=round(self.last_wait_s, 6),
+                    total_s=round(self.last_total_s, 6))
         return (dq.astype(q.dtype),
-                jnp.asarray(home[0]).astype(kv_dtype),
-                jnp.asarray(home[1]).astype(kv_dtype))
+                jnp.asarray(home_dk).astype(kv_dtype),
+                jnp.asarray(home_dv).astype(kv_dtype))

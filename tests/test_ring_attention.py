@@ -87,6 +87,71 @@ def test_ring_attention_world3_full_mqa():
     _run_ring(3, causal=False, h=4, kvh=1)
 
 
+def test_ring_attention_world4_causal():
+    """4 ranks: three rotations with the prefetch schedule (two kv
+    transfers in flight across the double buffer at peak)."""
+    _run_ring(4, causal=True, h=4, kvh=2, s_local=16)
+
+
+def test_ring_attention_serial_schedule_parity(monkeypatch):
+    """TDR_RA_NO_OVERLAP=1 (strictly serial rotate-then-compute) must
+    produce the identical result — the overlap is a schedule change,
+    not a numerics change."""
+    monkeypatch.setenv("TDR_RA_NO_OVERLAP", "1")
+    _run_ring(3, causal=True, h=4, kvh=2)
+
+
+def test_ring_attention_charges_staging_and_reports_wait():
+    """Every host bounce of the rotation (D2H of K/V, H2D of received
+    shards) is charged to collectives.staging, and the call reports
+    how long it blocked in transport waits (the overlap bench's raw
+    material)."""
+    from rocnrdma_tpu.collectives import staging as staging_mod
+    from rocnrdma_tpu.collectives.ring_attention import RingAttention
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    rng = np.random.default_rng(3)
+    world_size, s_local, h, d = 2, 16, 2, 16
+    q = rng.standard_normal((1, h, world_size * s_local, d)).astype(
+        np.float32)
+    worlds = local_worlds(world_size, free_port() + 950)
+    ras = [RingAttention(worlds[r], interpret=True)
+           for r in range(world_size)]
+    staging_mod.staging.reset()
+    before = staging_mod.staging.bytes
+    outs = [None] * world_size
+    errs = []
+
+    def go(r):
+        try:
+            sl = slice(r * s_local, (r + 1) * s_local)
+            # causal=False: every rank attends every remote shard, so
+            # the expected bounce count below is exact, not rank-
+            # dependent.
+            outs[r] = ras[r](q[:, :, sl], q[:, :, sl], q[:, :, sl],
+                             causal=False)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=go, args=(r,))
+          for r in range(world_size)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    kv_bytes = 2 * s_local * h * d * 4
+    # Per rank: >= one D2H of its own K/V + one H2D per attended
+    # remote shard.
+    assert staging_mod.staging.bytes - before >= world_size * 2 * kv_bytes
+    for ra in ras:
+        assert ra.last_total_s > 0
+        assert 0 <= ra.last_wait_s <= ra.last_total_s
+        ra.close()
+    for w in worlds:
+        w.close()
+
+
 def test_ring_attention_posts_only_work_requests():
     """Front-loaded registration (the reference invariant): after the
     first call, a second call registers nothing new — the rotation
