@@ -1,0 +1,221 @@
+"""Sequence-parallel training — ring attention wired into the trainer.
+
+Long-context training where the SEQUENCE is the partitioned axis: each
+rank (slice) holds a contiguous token shard of every batch row, and
+attention reaches the rest of the sequence through the transport-
+rotated K/V ring (``collectives/ring_attention.py``) — the SURVEY §5
+"L5 consumer" role: the model consumes the RDMA fabric the way the
+reference's MPI apps consumed its peer-mapped buffers
+(/root/reference/README.md:62-69).
+
+Architecture: the transformer block exposes its attention-split halves
+(``Block.qkv`` / ``Block.post``, models/llama.py) — everything except
+the attention contraction is position-local, so those halves run as
+ordinary jitted computations on the local shard, while the contraction
+itself runs as the host-orchestrated ring: per layer,
+
+    x ─jit→ qkv ─(ring: rotate K/V, merge by global lse)→ out ─jit→ post
+
+The step's backward is stitched from the same pieces, exactly: each
+jitted half contributes its ``jax.vjp`` pullback, and the attention
+middle uses :meth:`RingAttention.backward`, whose global-lse pair
+gradients + homecoming accumulator are parity-tested against the full
+``jax.vjp`` (tests/test_ring_attention.py). Parameter gradients then
+average across ranks over the SAME transport (``CrossSliceAllReduce``),
+because every rank's tokens contribute to every rank's dK/dV: with
+L = (1/W)·Σ_r ℓ_r and each rank seeding its backward with dℓ_r/dout_r,
+the mean-allreduce of per-rank parameter grads is algebraically
+dL/dθ (each rank's local chains carry Σ_j ∂ℓ_j/∂θ|through-rank-r).
+
+Replication contract: parameters and optimizer state are identical on
+every rank (same init seed, same averaged gradients, same update
+math), so ranks stay bit-synchronized without a parameter server.
+
+Not yet done here: activation rematerialization (the vjp pullbacks
+hold one layer of residuals each) and intra-rank tensor parallelism —
+the seq axis composes with the jit-internal dp/tp mesh of
+``parallel/trainer.py`` in the usual grid fashion but this runner
+drives one device per rank.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+from rocnrdma_tpu.collectives.ring_attention import RingAttention
+from rocnrdma_tpu.collectives.world import RingWorld
+from rocnrdma_tpu.models.llama import (
+    Block, LlamaConfig, RMSNorm, cross_entropy_loss, make_model,
+    rope_freqs)
+from rocnrdma_tpu.utils.trace import trace
+
+
+class SeqParallelTrainer:
+    """Trains a Llama model with the sequence sharded across a
+    :class:`RingWorld` — ``Trainer(config, seq_parallel=world)`` is the
+    front-door spelling.
+
+    ``step(inputs, targets)`` takes this rank's contiguous
+    (B, S_local) token shard (inputs and next-token targets already
+    split by the caller, the same split on every rank) and returns the
+    GLOBAL mean loss. All ranks must call ``step`` collectively.
+    """
+
+    def __init__(self, config: "LlamaConfig | str", world: RingWorld,
+                 learning_rate: float = 3e-4, weight_decay: float = 0.1,
+                 seed: int = 0, interpret: Optional[bool] = None,
+                 optimizer=None, **model_overrides):
+        self.model = make_model(config, **model_overrides)
+        self.cfg = cfg = self.model.cfg
+        self.world = world
+        if interpret is None:
+            interpret = cfg.pallas_interpret
+        self.ring_attention = RingAttention(world, interpret=interpret)
+        self._xs = CrossSliceAllReduce(world, mean=True)
+        # ``optimizer``: any optax GradientTransformation; the default
+        # matches the DP trainer. (The parity tests inject plain SGD —
+        # adaptive optimizers amplify fp-reordering-scale gradient
+        # differences through the 1/(sqrt(v)+eps) normalization, which
+        # makes bit-level param comparison meaningless, not wrong.)
+        self.tx = optimizer if optimizer is not None else optax.adamw(
+            learning_rate, weight_decay=weight_decay)
+
+        # Identical params on every rank: same seed, same init graph.
+        self.params = self.model.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, 8), dtype=jnp.int32))
+        self.opt_state = self.tx.init(self.params)
+
+        # Jitted local segments. One compile each (shapes repeat across
+        # layers and steps); the block instance is shared so every
+        # layer reuses the same executables with its own param subtree.
+        block = Block(cfg)
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=cfg.dtype)
+        norm = RMSNorm(cfg)
+        head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.dtype)
+        self._embed = jax.jit(
+            lambda ep, t: embed.apply({"params": ep}, t))
+        self._qkv = jax.jit(
+            lambda lp, x, fr: block.apply({"params": lp}, x, fr,
+                                          method=Block.qkv))
+        self._post = jax.jit(
+            lambda lp, x, o: block.apply({"params": lp}, x, o,
+                                         method=Block.post))
+
+        def logits_fn(fp, hp, x):
+            xn = norm.apply({"params": fp}, x)
+            return head.apply({"params": hp}, xn).astype(jnp.float32)
+
+        self._logits = jax.jit(logits_fn)
+        self._head_loss = jax.jit(
+            lambda fp, hp, x, targets: cross_entropy_loss(
+                logits_fn(fp, hp, x), targets))
+        self._apply = jax.jit(
+            lambda g, o, p: self.tx.update(g, o, p))
+        self._freqs = rope_freqs(cfg.head_dim, cfg.max_seq_len,
+                                 cfg.rope_theta)
+
+    # --------------------------------------------------------- forward
+
+    def _freqs_shard(self, s_local: int):
+        off = self.world.rank * s_local
+        if off + s_local > self.cfg.max_seq_len:
+            raise ValueError(
+                f"global sequence {self.world.world * s_local} exceeds "
+                f"max_seq_len={self.cfg.max_seq_len}")
+        return jax.lax.dynamic_slice_in_dim(self._freqs, off, s_local)
+
+    def forward(self, params, inputs):
+        """Logits for this rank's shard (no loss) — the inference
+        spelling of the seq-parallel forward, used by the parity
+        tests."""
+        p = params["params"]
+        fr = self._freqs_shard(inputs.shape[1])
+        x = self._embed(p["embed"], inputs)
+        for i in range(self.cfg.n_layers):
+            lp = p[f"layer_{i}"]
+            q, k, v = self._qkv(lp, x, fr)
+            out, _ = self.ring_attention.forward(q, k, v, causal=True)
+            x = self._post(lp, x, out)
+        return self._logits(p["final_norm"], p["lm_head"], x)
+
+    # ------------------------------------------------ forward+backward
+
+    def forward_backward(self, params, inputs, targets):
+        """(local_loss, grads): exact gradients of this rank's local
+        mean loss chains — see the module docstring for why the
+        mean-allreduce of these across ranks is the global-loss
+        gradient. Residual memory is one pullback per layer (no remat
+        yet)."""
+        p = params["params"]
+        fr = self._freqs_shard(inputs.shape[1])
+        x, pull_embed = jax.vjp(
+            lambda ep: self._embed(ep, inputs), p["embed"])
+        pulls = []
+        residuals = []
+        for i in range(self.cfg.n_layers):
+            lp = p[f"layer_{i}"]
+            (q, k, v), pull_qkv = jax.vjp(
+                lambda lp_, x_: self._qkv(lp_, x_, fr), lp, x)
+            out, lse = self.ring_attention.forward(q, k, v, causal=True)
+            x, pull_post = jax.vjp(
+                lambda lp_, x_, o_: self._post(lp_, x_, o_), lp, x, out)
+            pulls.append((pull_qkv, pull_post))
+            residuals.append((q, k, v, out, lse))
+        loss, pull_head = jax.vjp(
+            lambda fp, hp, x_: self._head_loss(fp, hp, x_, targets),
+            p["final_norm"], p["lm_head"], x)
+
+        g_final, g_head, dx = pull_head(jnp.ones((), jnp.float32))
+        grads = {"final_norm": g_final, "lm_head": g_head}
+        add = lambda a, b: jax.tree_util.tree_map(jnp.add, a, b)
+        for i in reversed(range(self.cfg.n_layers)):
+            pull_qkv, pull_post = pulls[i]
+            q, k, v, out, lse = residuals[i]
+            g_post, dx, dout = pull_post(dx)
+            dq, dk, dv = self.ring_attention.backward(
+                q, k, v, out, lse, dout, causal=True)
+            g_qkv, dx2 = pull_qkv((dq, dk, dv))
+            dx = add(dx, dx2)
+            grads[f"layer_{i}"] = add(g_post, g_qkv)
+        (grads["embed"],) = pull_embed(dx)
+        return loss, {"params": grads}
+
+    # ------------------------------------------------------------ step
+
+    def step(self, inputs, targets) -> float:
+        """One collective optimizer step on this rank's shard; returns
+        the global mean loss. Parameter gradients average across ranks
+        over the transport (the same ring the K/V rotation used)."""
+        inputs = jnp.asarray(inputs)
+        targets = jnp.asarray(targets)
+        loss, grads = self.forward_backward(self.params, inputs, targets)
+        grads = self._xs(grads)
+        updates, self.opt_state = self._apply(
+            grads, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        # Global loss: mean of the per-rank local means (equal shards).
+        box = np.array([float(loss)], dtype=np.float64)
+        self.world.allreduce(box)
+        gloss = float(box[0]) / self.world.world
+        trace.event("seq_parallel.step", rank=self.world.rank,
+                    world=self.world.world, loss=gloss)
+        return gloss
+
+    def close(self) -> None:
+        self.ring_attention.close()
+        self._xs.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -41,6 +41,32 @@ def loss_fn(model: Llama, params, tokens) -> jnp.ndarray:
 
 
 class Trainer:
+    def __new__(cls, *args, **kwargs):
+        # Front door for sequence parallelism: Trainer(cfg,
+        # seq_parallel=ring_world) constructs the layerwise seq-
+        # parallel runner instead (parallel/seq_parallel.py) — the
+        # sequence axis is partitioned across the transport ring, not
+        # the jit-internal mesh, so it is a different orchestration.
+        if cls is Trainer and kwargs.get("seq_parallel") is not None:
+            from rocnrdma_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+            kw = dict(kwargs)
+            world = kw.pop("seq_parallel")
+            if len(args) > 1:
+                # Positional mesh_shape (the two_slice_dp.py spelling)
+                # would otherwise land in SeqParallelTrainer's world
+                # slot with a baffling TypeError.
+                raise ValueError(
+                    "mesh_shape does not apply to the seq_parallel "
+                    "trainer (one device per ring rank)")
+            for unsupported in ("mesh_shape", "devices", "cross_slice_sync"):
+                if kw.pop(unsupported, None) is not None:
+                    raise ValueError(
+                        f"{unsupported} does not apply to the "
+                        "seq_parallel trainer (one device per ring rank)")
+            return SeqParallelTrainer(*args, world=world, **kw)
+        return super().__new__(cls)
+
     def __init__(
         self,
         config: "LlamaConfig | str",
@@ -50,6 +76,7 @@ class Trainer:
         cross_slice_sync: Optional[Callable[[Any], Any]] = None,
         devices=None,
         seed: int = 0,
+        seq_parallel=None,  # None = disabled; non-None handled by __new__
         **model_overrides,
     ):
         self.model = make_model(config, **model_overrides)

@@ -1,0 +1,173 @@
+"""Sequence-parallel trainer: parity vs the single-host model.
+
+Each rank holds a contiguous token shard; attention reaches the full
+sequence via the transport-rotated K/V ring; parameter gradients
+average over the same transport. The whole path — layerwise jitted
+halves + ring attention middle + stitched backward + mean-allreduce —
+must reproduce the single-host full-sequence model: logits, loss, and
+the trained parameters themselves.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from test_transport import free_port
+
+
+def _tiny(**kw):
+    from rocnrdma_tpu.models.llama import LLAMA_TINY, make_model
+
+    return make_model(LLAMA_TINY, **kw)
+
+
+def _run_ranks(world_size, fn, base_port):
+    """fn(rank, worlds) in one thread per rank; surfaces exceptions."""
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    worlds = local_worlds(world_size, base_port)
+    results = [None] * world_size
+    errs = []
+
+    def go(r):
+        try:
+            results[r] = fn(r, worlds[r])
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            import traceback
+
+            errs.append((r, e, traceback.format_exc()))
+
+    ts = [threading.Thread(target=go, args=(r,))
+          for r in range(world_size)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for w in worlds:
+        w.close()
+    assert not errs, errs[0][2]
+    return results
+
+
+def test_seq_parallel_forward_logits_parity():
+    """Per-rank seq-parallel logits, concatenated, equal the
+    single-host full-sequence forward."""
+    import jax
+    import jax.numpy as jnp
+
+    from rocnrdma_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+    world_size, s_local, batch = 2, 16, 2
+    S = world_size * s_local
+    rng = np.random.default_rng(0)
+    inputs = rng.integers(0, 255, size=(batch, S)).astype(np.int32)
+
+    def rank_fn(r, world):
+        tr = SeqParallelTrainer("llama-tiny", world, seed=0,
+                                interpret=True)
+        sl = slice(r * s_local, (r + 1) * s_local)
+        logits = np.asarray(tr.forward(tr.params, inputs[:, sl]))
+        params = tr.params
+        tr.close()
+        return logits, params
+
+    results = _run_ranks(world_size, rank_fn, free_port() + 100)
+    got = np.concatenate([lg for lg, _ in results], axis=1)
+
+    model = _tiny()
+    params = results[0][1]  # identical across ranks (same seed)
+    want = np.asarray(model.apply(params, jnp.asarray(inputs)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+def test_seq_parallel_training_matches_single_host(world_size):
+    """N optimizer steps of the seq-parallel trainer reproduce
+    single-host full-sequence training: per-step global losses AND the
+    final parameters (ranks stay replicated)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from rocnrdma_tpu.models.llama import cross_entropy_loss
+    from rocnrdma_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+    # SGD, not adamw: updates are LINEAR in the gradients, so the
+    # fp-reordering-scale differences between the stitched and fused
+    # backwards stay that scale in the trained params. (Adaptive
+    # optimizers divide by sqrt(second moment); for a weight whose v≈0
+    # a 1e-7 gradient difference flips the whole ±lr update — param
+    # comparison after adamw steps measures chaos, not correctness.)
+    s_local, batch, steps, lr = 16, 2, 3, 5e-2
+    S = world_size * s_local
+    rng = np.random.default_rng(world_size)
+    data = [rng.integers(0, 255, size=(batch, S + 1)).astype(np.int32)
+            for _ in range(steps)]
+
+    def rank_fn(r, world):
+        tr = SeqParallelTrainer("llama-tiny", world, seed=0,
+                                interpret=True, optimizer=optax.sgd(lr))
+        sl = slice(r * s_local, (r + 1) * s_local)
+        losses = []
+        for tok in data:
+            inputs = tok[:, :-1][:, sl]
+            targets = tok[:, 1:][:, sl]
+            losses.append(tr.step(inputs, targets))
+        params = tr.params
+        tr.close()
+        return losses, params
+
+    results = _run_ranks(world_size, rank_fn, free_port() + 200)
+    # Every rank reports the same global loss and holds identical
+    # params (the replication contract).
+    for losses, params in results[1:]:
+        np.testing.assert_allclose(losses, results[0][0], rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(params),
+                        jax.tree_util.tree_leaves(results[0][1])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Single-host reference: same init, same optimizer, full sequence.
+    model = _tiny()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), dtype=jnp.int32))
+    tx = optax.sgd(lr)
+    opt = tx.init(params)
+
+    @jax.jit
+    def ref_step(p, o, tok):
+        def loss_fn(p_):
+            logits = model.apply(p_, tok[:, :-1])
+            return cross_entropy_loss(logits, tok[:, 1:])
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        up, o = tx.update(g, o, p)
+        return optax.apply_updates(p, up), o, loss
+
+    ref_losses = []
+    for tok in data:
+        params, opt, loss = ref_step(params, opt, jnp.asarray(tok))
+        ref_losses.append(float(loss))
+
+    np.testing.assert_allclose(results[0][0], ref_losses,
+                               rtol=2e-4, atol=2e-4)
+    got_leaves = jax.tree_util.tree_leaves(results[0][1])
+    want_leaves = jax.tree_util.tree_leaves(params)
+    for a, b in zip(got_leaves, want_leaves):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_trainer_seq_parallel_front_door():
+    """Trainer(cfg, seq_parallel=world) constructs the seq-parallel
+    runner (the VERDICT's requested spelling)."""
+    from rocnrdma_tpu.parallel.seq_parallel import SeqParallelTrainer
+    from rocnrdma_tpu.parallel.trainer import Trainer
+
+    def rank_fn(r, world):
+        tr = Trainer("llama-tiny", seq_parallel=world, interpret=True)
+        ok = isinstance(tr, SeqParallelTrainer)
+        tr.close()
+        return ok
+
+    assert all(_run_ranks(2, rank_fn, free_port() + 300))
