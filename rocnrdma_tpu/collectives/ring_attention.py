@@ -249,11 +249,19 @@ class RingAttention:
         cur = 0
 
         def shard_kv(idx: int):
-            # H2D bounce: jnp.asarray copies the in-place views onto
-            # the compute device.
+            # H2D bounce of the received shard. SNAPSHOT out of the
+            # rotation buffer first: jax's CPU backend zero-copy-
+            # aliases aligned numpy memory and every consumer kernel
+            # runs lazily, so handing the live buffer to jnp.asarray
+            # races with the next rotation landing in it (caught as a
+            # world-3 parity failure under load). np.array FIRST —
+            # jnp.array(copy=True) only guarantees the RESULT doesn't
+            # alias, not that the source is consumed before return
+            # (async-transfer backends may read the host buffer
+            # after); the numpy copy is unambiguously synchronous.
             ks, vs = self._unpack_kv(idx, k_host, v_host, kv_dtype)
             staging.add(kv_bytes)
-            return jnp.asarray(ks), jnp.asarray(vs)
+            return jnp.asarray(np.array(ks)), jnp.asarray(np.array(vs))
 
         # Prefetch rotation 1 BEFORE the local compute: the first wire
         # transfer hides behind the local shard's attention kernel.
@@ -406,7 +414,10 @@ class RingAttention:
                     ks_h, vs_h = self._unpack_kv(kv_cur, k_host, v_host,
                                                  kv_dtype)
                     staging.add(kv_bytes)  # H2D of the received shard
-                    ks, vs = jnp.asarray(ks_h), jnp.asarray(vs_h)
+                    # Snapshot before jnp.asarray — same aliasing
+                    # hazard as the forward's shard_kv.
+                    ks = jnp.asarray(np.array(ks_h))
+                    vs = jnp.asarray(np.array(vs_h))
                 if overlap and step + 2 < world:
                     self._post_rot(_CH_KV, step + 2, kv_cur, 0, kv_bytes)
         if overlap:
@@ -423,6 +434,10 @@ class RingAttention:
                     overlap=int(overlap),
                     wait_s=round(self.last_wait_s, 6),
                     total_s=round(self.last_total_s, 6))
+        # Snapshot the homecoming region: the returned arrays outlive
+        # this call (the trainer's pullbacks consume them lazily), and
+        # the NEXT call — e.g. the adjacent layer's backward on the
+        # same instance — zeroes and rotates these very bytes.
         return (dq.astype(q.dtype),
-                jnp.asarray(home_dk).astype(kv_dtype),
-                jnp.asarray(home_dv).astype(kv_dtype))
+                jnp.asarray(np.array(home_dk)).astype(kv_dtype),
+                jnp.asarray(np.array(home_dv)).astype(kv_dtype))

@@ -127,7 +127,10 @@ class SeqParallelTrainer:
 
     def _freqs_shard(self, s_local: int):
         off = self.world.rank * s_local
-        if off + s_local > self.cfg.max_seq_len:
+        # Checked against the GLOBAL length so every rank raises (an
+        # off+s_local check fires only on the last ranks, leaving the
+        # rest to stall in the ring until the transport timeout).
+        if self.world.world * s_local > self.cfg.max_seq_len:
             raise ValueError(
                 f"global sequence {self.world.world * s_local} exceeds "
                 f"max_seq_len={self.cfg.max_seq_len}")

@@ -79,6 +79,14 @@ class Trainer:
         seq_parallel=None,  # None = disabled; non-None handled by __new__
         **model_overrides,
     ):
+        if seq_parallel is not None:
+            # Unreachable via Trainer(...) (__new__ intercepts), so
+            # this only fires for subclasses, where silently dropping
+            # the flag would hand back a plain DP trainer.
+            raise ValueError(
+                "seq_parallel requires the Trainer base class "
+                "(__new__ dispatches to SeqParallelTrainer; subclasses "
+                "are not intercepted)")
         self.model = make_model(config, **model_overrides)
         self.cfg = self.model.cfg
         self.mesh = make_mesh(mesh_shape or {"dp": 1, "tp": 1}, devices)
@@ -124,7 +132,8 @@ class Trainer:
                 self.model = make_model(self.cfg, **pins)
                 self.cfg = self.model.cfg
             if ((resolve_pallas(self.cfg.use_pallas_attention) and attn_ok)
-                    or (resolve_pallas(self.cfg.use_pallas_rmsnorm)
+                    or (resolve_pallas(self.cfg.use_pallas_rmsnorm,
+                                       tpu_default=False)
                         and rms_ok)):
                 self._trace_ctx = lambda: pallas_sharding(
                     self.mesh, batch_axis="dp", head_axis="tp")
