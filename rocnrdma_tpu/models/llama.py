@@ -43,7 +43,9 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
-    # None = auto: Pallas on TPU backends, XLA reference elsewhere.
+    # None = auto: per-pass measured default on TPU backends (flash
+    # attention Pallas, rmsnorm XLA — see resolve_pallas), XLA
+    # reference elsewhere.
     use_pallas_attention: Optional[bool] = None
     use_pallas_rmsnorm: Optional[bool] = None
     pallas_interpret: bool = False
@@ -88,18 +90,35 @@ LLAMA_TINY = LlamaConfig(
 CONFIGS = {c.name: c for c in (LLAMA3_8B, LLAMA3_1B, LLAMA_TINY)}
 
 
-def resolve_pallas(flag: "Optional[bool]") -> bool:
-    """Resolve a tri-state Pallas flag: explicit True/False wins;
-    ``None`` (auto) selects the fused kernels exactly when the default
-    JAX backend is TPU — on CPU the Pallas TPU lowering is unavailable
-    (interpret mode is test-only), and on TPU the kernels ARE the
-    compute path."""
-    if flag is not None:
-        return flag
+def _tpu_backend() -> bool:
+    """True when the default backend drives TPU devices — including
+    tunneled PJRT plugins whose platform name is NOT "tpu" (the axon
+    tunnel reports platform "axon" but device_kind "TPU v5 lite";
+    matching on the platform string alone silently disabled the auto
+    default on the one environment it was built for)."""
     try:
-        return jax.default_backend() == "tpu"
+        if jax.default_backend() == "tpu":
+            return True
+        devs = jax.devices()
+        return bool(devs) and "tpu" in str(
+            getattr(devs[0], "device_kind", "")).lower()
     except Exception:  # backend init failure → safe XLA path
         return False
+
+
+def resolve_pallas(flag: "Optional[bool]", tpu_default: bool = True) -> bool:
+    """Resolve a tri-state Pallas flag: explicit True/False wins;
+    ``None`` (auto) selects the fused kernels only on TPU backends —
+    on CPU the Pallas TPU lowering is unavailable (interpret mode is
+    test-only) — and, there, per ``tpu_default``: the default is
+    per-PASS, set by on-chip measurement, not globally (VERDICT r04
+    weak-2: flipping everything to Pallas was ahead of the evidence).
+    v5e, llama3-1b shapes (TPU_RESULTS_r05_extra.json): flash
+    attention Pallas 7223 µs vs XLA 10541 µs → default ON; rmsnorm
+    Pallas 544 µs vs XLA 437 µs → default OFF."""
+    if flag is not None:
+        return flag
+    return tpu_default and _tpu_backend()
 
 
 def rope_freqs(head_dim: int, max_seq: int, theta: float) -> jnp.ndarray:
@@ -127,7 +146,8 @@ class RMSNorm(nn.Module):
         w = self.param("weight", nn.initializers.ones, (x.shape[-1],),
                        jnp.float32)
         return rmsnorm(x, w, self.cfg.norm_eps,
-                       use_pallas=resolve_pallas(self.cfg.use_pallas_rmsnorm),
+                       use_pallas=resolve_pallas(self.cfg.use_pallas_rmsnorm,
+                                                 tpu_default=False),
                        interpret=self.cfg.pallas_interpret)
 
 
