@@ -123,6 +123,37 @@ def test_pallas_auto_default_resolution():
     assert m.cfg.use_pallas_rmsnorm is False
 
 
+def test_pallas_auto_default_is_per_pass_on_tpu(monkeypatch):
+    """On a TPU backend the auto default is per-PASS, from on-chip
+    measurement (TPU_RESULTS_r05_extra.json: flash attention beats XLA
+    7223 vs 10541 us, rmsnorm loses 544 vs 437): attention -> Pallas,
+    rmsnorm -> XLA. Also covers tunneled PJRT platforms whose platform
+    string is not "tpu" but whose devices are TPU chips (the axon
+    case, where matching on backend name alone disabled the kernels on
+    the one environment they target)."""
+    from rocnrdma_tpu.models import llama
+
+    monkeypatch.setattr(llama, "_tpu_backend", lambda: True)
+    assert llama.resolve_pallas(None) is True  # attention default
+    assert llama.resolve_pallas(None, tpu_default=False) is False
+    assert llama.resolve_pallas(False) is False  # explicit still wins
+    assert llama.resolve_pallas(True, tpu_default=False) is True
+
+    # The tunneled-platform detection itself: device_kind carries
+    # "TPU" even when the platform name does not.
+    class FakeDev:
+        device_kind = "TPU v5 lite"
+
+    monkeypatch.undo()
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    monkeypatch.setattr(jax, "devices", lambda: [FakeDev()])
+    assert llama._tpu_backend() is True
+    monkeypatch.setattr(jax, "devices", lambda: [])
+    assert llama._tpu_backend() is False
+
+
 def test_flash_attention_pallas_backward_parity():
     """The hand-written Pallas backward (dK/dV and dQ kernels driven
     by saved lse + delta = rowsum(dO∘O)) must match grads of the XLA

@@ -1,0 +1,58 @@
+"""Shared plumbing for the TPU measurement tools.
+
+One copy of the attempt-log schema, the accelerator probe, and the
+threaded per-rank fan-out — the tools (tpu_extra, tpu_chase,
+staged_tpu_demo, ring_attention_tpu_demo, ring_attention_cpu_overlap)
+each used to carry near-identical private copies, so a schema change
+had to be applied everywhere or the logs diverged.
+"""
+import json
+import os
+import threading
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROUND = os.environ.get("TDR_ROUND", "r05")
+ATTEMPTS = os.path.join(REPO, f"TPU_ATTEMPTS_{ROUND}.jsonl")
+
+
+def log_attempt(tool: str, rec: dict) -> None:
+    rec = dict(rec)
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    rec["tool"] = tool
+    with open(ATTEMPTS, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def accel_devices():
+    """Non-CPU jax devices, or [] — import deferred so callers control
+    backend selection first."""
+    import jax
+
+    return [d for d in jax.devices() if d.platform != "cpu"]
+
+
+def run_ranks(world: int, fn) -> list:
+    """fn(rank) per thread; re-raises the first rank's exception after
+    all threads join (a swallowed worker exception otherwise surfaces
+    later as a misleading TypeError on a None result — and the tool
+    dies without writing its attempt log)."""
+    results = [None] * world
+    errs = []
+
+    def go(r):
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs.append((r, e, traceback.format_exc()))
+
+    ts = [threading.Thread(target=go, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise RuntimeError(
+            f"rank {errs[0][0]} failed:\n{errs[0][2]}") from errs[0][1]
+    return results

@@ -24,22 +24,16 @@ Writes TPU_RESULTS_<round>_ringattn.json; appends to the attempt log.
 import json
 import os
 import sys
-import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-ROUND = os.environ.get("TDR_ROUND", "r05")
-ATTEMPTS = os.path.join(REPO, f"TPU_ATTEMPTS_{ROUND}.jsonl")
+from _tpu_common import ROUND, accel_devices, log_attempt, run_ranks  # noqa: E402
+
+TOOL = "ring_attention_tpu_demo"
 RESULTS = os.path.join(REPO, f"TPU_RESULTS_{ROUND}_ringattn.json")
-
-
-def log_attempt(rec):
-    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    rec["tool"] = "ring_attention_tpu_demo"
-    with open(ATTEMPTS, "a") as f:
-        f.write(json.dumps(rec) + "\n")
 
 
 def main():
@@ -48,9 +42,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    devs = accel_devices()
     if not devs:
-        log_attempt({"ok": False, "error": "no accelerator devices"})
+        log_attempt(TOOL, {"ok": False, "error": "no accelerator devices"})
         print(json.dumps({"error": "no accelerator devices"}))
         return 1
     dev = devs[0]
@@ -89,7 +83,6 @@ def main():
     try:
         for mode, env in (("serial", "1"), ("overlap", "0")):
             os.environ["TDR_RA_NO_OVERLAP"] = env
-            res = [None] * W
 
             def fwd_bwd(r):
                 o, lse = ras[r].forward(qs[r], ks[r], vs[r], causal=True)
@@ -98,21 +91,13 @@ def main():
                 g = ras[r].backward(qs[r], ks[r], vs[r], o, lse, dos[r],
                                     causal=True)
                 jax.block_until_ready(g)
-                res[r] = (fw, ft, ras[r].last_wait_s, ras[r].last_total_s)
+                return (fw, ft, ras[r].last_wait_s, ras[r].last_total_s)
 
-            def run_all():
-                ts = [threading.Thread(target=fwd_bwd, args=(r,))
-                      for r in range(W)]
-                for t in ts:
-                    t.start()
-                for t in ts:
-                    t.join()
-
-            run_all()  # warm: compiles + registers rotation buffers
+            run_ranks(W, fwd_bwd)  # warm: compiles + registers buffers
             iters = 3
             t0 = time.perf_counter()
             for _ in range(iters):
-                run_all()
+                res = run_ranks(W, fwd_bwd)
             wall = (time.perf_counter() - t0) / iters
             fwaits = [r[0] for r in res]
             bwaits = [r[2] for r in res]
@@ -137,11 +122,16 @@ def main():
 
     with open(RESULTS, "w") as f:
         json.dump(out, f, indent=1)
-    log_attempt({"ok": True, "speedup": out.get("overlap_speedup"),
-                 "hidden": out.get("hidden_fraction")})
+    log_attempt(TOOL, {"ok": True, "speedup": out.get("overlap_speedup"),
+                       "hidden": out.get("hidden_fraction")})
     print(json.dumps(out))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BaseException as e:  # noqa: BLE001 — every run must log
+        log_attempt(TOOL, {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"[:400]})
+        raise

@@ -26,22 +26,16 @@ import argparse
 import json
 import os
 import sys
-import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-ROUND = os.environ.get("TDR_ROUND", "r05")
-ATTEMPTS = os.path.join(REPO, f"TPU_ATTEMPTS_{ROUND}.jsonl")
+from _tpu_common import ROUND, accel_devices, log_attempt, run_ranks  # noqa: E402
+
+TOOL = "staged_tpu_demo"
 RESULTS = os.path.join(REPO, f"TPU_RESULTS_{ROUND}_staged.json")
-
-
-def log_attempt(rec):
-    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    rec["tool"] = "staged_tpu_demo"
-    with open(ATTEMPTS, "a") as f:
-        f.write(json.dumps(rec) + "\n")
 
 
 def main():
@@ -55,9 +49,9 @@ def main():
 
     import jax
 
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    devs = accel_devices()
     if not devs:
-        log_attempt({"ok": False, "error": "no accelerator devices"})
+        log_attempt(TOOL, {"ok": False, "error": "no accelerator devices"})
         print(json.dumps({"error": "no accelerator devices"}))
         return 1
     dev = devs[0]
@@ -87,20 +81,11 @@ def main():
     try:
         # Correctness first: a synced tree must hold the rank sum.
         trees = make_trees()
-        res = [None, None]
-
-        def sync(r, tree):
-            res[r] = shims[r](tree)
 
         def sync_all(trees):
-            ts = [threading.Thread(target=sync, args=(r, trees[r]))
-                  for r in range(2)]
-            for t in ts:
-                t.start()
-            for t in ts:
-                t.join()
+            return run_ranks(2, lambda r: shims[r](trees[r]))
 
-        sync_all(trees)
+        res = sync_all(trees)
         got = np.asarray(res[0][0])[:8]
         if not np.allclose(got, 3.0):
             raise AssertionError(f"staged sync wrong: {got[:4]} != 3.0")
@@ -130,10 +115,15 @@ def main():
 
     with open(RESULTS, "w") as f:
         json.dump(out, f, indent=1)
-    log_attempt({"ok": True, "speedup": out.get("pipeline_speedup")})
+    log_attempt(TOOL, {"ok": True, "speedup": out.get("pipeline_speedup")})
     print(json.dumps(out))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BaseException as e:  # noqa: BLE001 — every run must log
+        log_attempt(TOOL, {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"[:400]})
+        raise
