@@ -44,28 +44,42 @@ def main():
 
     worlds = local_worlds(W, args.port)
     losses = [None] * W
+    errs = []
 
     def run_rank(r):
         # The front door: Trainer dispatches to the seq-parallel
         # runner when seq_parallel is a RingWorld.
-        tr = Trainer("llama-tiny", seq_parallel=worlds[r], seed=0,
-                     interpret=True)
-        sl_ = slice(r * sl, (r + 1) * sl)
-        ls = []
-        for tok in data:
-            ls.append(tr.step(tok[:, :-1][:, sl_], tok[:, 1:][:, sl_]))
-        losses[r] = ls
-        tr.close()
+        try:
+            tr = Trainer("llama-tiny", seq_parallel=worlds[r], seed=0,
+                         interpret=True)
+            sl_ = slice(r * sl, (r + 1) * sl)
+            ls = []
+            for tok in data:
+                ls.append(tr.step(tok[:, :-1][:, sl_],
+                                  tok[:, 1:][:, sl_]))
+            losses[r] = ls
+            tr.close()
+        except BaseException:  # noqa: BLE001 — surfaced below
+            import traceback
+
+            errs.append(traceback.format_exc())
+            raise
 
     t0 = time.perf_counter()
     ts = [threading.Thread(target=run_rank, args=(r,)) for r in range(W)]
     for t in ts:
         t.start()
+    # A failed rank would leave its peers blocked in the ring; join
+    # with a timeout and surface the first traceback instead of
+    # hanging silently.
     for t in ts:
-        t.join()
+        t.join(timeout=600)
     dt = time.perf_counter() - t0
     for w in worlds:
         w.close()
+    if errs:
+        sys.stderr.write(errs[0])
+        return 1
 
     assert all(ls is not None for ls in losses)
     for ls in losses[1:]:  # every rank reports the same global loss

@@ -56,7 +56,13 @@ def main():
     print("INIT", out["init_s"], "s rss", out["rss_after_init_GiB"],
           flush=True)
 
-    tx = optax.sgd(1e-4)
+    # lr chosen for the WITNESS, not for training: params are bf16
+    # (8-bit mantissa), so an O(1e-4) update to an O(1) weight rounds
+    # to no representable change — the first run of this tool proved
+    # the step ran (sane loss, 62 GiB peak) yet showed
+    # params_changed=false for exactly that reason. 0.5*grad is
+    # visible in bf16.
+    tx = optax.sgd(0.5)
     opt = tx.init(params)
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(
@@ -72,17 +78,36 @@ def main():
         up, o = tx.update(g, o, p)
         return optax.apply_updates(p, up), o, loss
 
-    # Witness a real update: one embedding row before/after.
-    before = np.asarray(
-        params["params"]["embed"]["embedding"][1, :4]).copy()
+    # Witness a real update: the embedding row of a token that IS in
+    # the batch (a random id is ~never among 512 draws from a 128k
+    # vocab — the first run's witness bug) plus the final-norm weight,
+    # which every position's gradient touches.
+    wit_tok = int(tokens[0, 0])
+    before_emb = np.asarray(
+        params["params"]["embed"]["embedding"][wit_tok, :8],
+        dtype=np.float32).copy()
+    before_norm = np.asarray(
+        params["params"]["final_norm"]["weight"][:8],
+        dtype=np.float32).copy()
     t0 = time.time()
     params, opt, loss = step(params, opt, tokens)
     loss = float(loss)
     out["step_wall_s"] = round(time.time() - t0, 1)
     out["loss"] = round(loss, 4)
     out["loss_sane"] = bool(0 < loss < 20)
-    after = np.asarray(params["params"]["embed"]["embedding"][1, :4])
-    out["params_changed"] = bool(np.any(before != after))
+    after_emb = np.asarray(
+        params["params"]["embed"]["embedding"][wit_tok, :8],
+        dtype=np.float32)
+    after_norm = np.asarray(
+        params["params"]["final_norm"]["weight"][:8], dtype=np.float32)
+    out["witness_token"] = wit_tok
+    out["emb_row_max_abs_delta"] = float(
+        np.max(np.abs(after_emb - before_emb)))
+    out["final_norm_max_abs_delta"] = float(
+        np.max(np.abs(after_norm - before_norm)))
+    out["params_changed"] = bool(
+        out["emb_row_max_abs_delta"] > 0
+        or out["final_norm_max_abs_delta"] > 0)
     out["rss_peak_GiB"] = rss_gib()
     with open(RESULTS, "w") as f:
         json.dump(out, f, indent=1)

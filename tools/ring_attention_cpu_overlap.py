@@ -59,6 +59,17 @@ def main():
     ras = [RingAttention(w, interpret=True) for w in worlds]
     grads = {}
     try:
+        # Warm pass (untimed, fwd AND bwd): interpret-mode tracing and
+        # rotation-buffer registration are one-time costs; without
+        # this the serial mode (measured first) absorbs them and the
+        # A/B is structurally asymmetric.
+        def warm(r):
+            o, lse = ras[r].forward(qs[r], ks[r], vs[r], causal=True)
+            ras[r].backward(qs[r], ks[r], vs[r], o, lse, dos[r],
+                            causal=True)
+
+        run_ranks(W, warm)
+
         for mode, env in (("serial", "1"), ("overlap", "0")):
             os.environ["TDR_RA_NO_OVERLAP"] = env
 

@@ -45,7 +45,13 @@ out = {"ts": time.strftime("%%Y-%%m-%%dT%%H:%%M:%%SZ", time.gmtime())}
 devs = [d for d in jax.devices() if d.platform != "cpu"]
 dev = devs[0]
 out["device_kind"] = getattr(dev, "device_kind", "?")
-out["sections"] = sorted(_SECT)
+# Requested vs COMPLETED kept separate: a timeout mid-run must not
+# leave a bank claiming sections that never executed (each section
+# appends to sections_completed only when it finishes).
+out["sections_requested"] = sorted(_SECT)
+out["sections_completed"] = []
+def done(name):
+    out["sections_completed"].append(name)
 print("STEP devices", flush=True)
 # Partial-result checkpoints: the tunnel (or an OOM in a later step)
 # can kill the run — emit the accumulated dict after every section so
@@ -62,6 +68,7 @@ if "entry" in _SECT:
     jax.tree_util.tree_map(lambda a: a.block_until_ready(), r)
     out["entry_auto_pallas_compiles"] = True
     del fn, args, jfn, r
+    done("entry")
     print("STEP entry", flush=True)
     part()
 
@@ -107,6 +114,7 @@ if "ops" in _SECT:
     # Free every device array this section left alive — the 16 GiB
     # chip needs the room for the training section.
     del rp, rr, x, w, q, k, v, f_p, f_r, a_p, a_r
+    done("ops")
     print("STEP attention", flush=True)
     part()
 
@@ -161,6 +169,8 @@ for label, overrides in ((("xla", {"use_pallas_attention": False,
     del p2, o2, l
     gc.collect()
     print(f"STEP train_{label}", flush=True)
+    if label == "pallas":
+        done("train")
     part()
 
 # --- long-sequence attention: where flash pays ----------------------
@@ -196,7 +206,46 @@ for seq_l in ((4096, 8192) if "longseq" in _SECT else ()):
     gc.collect()
 if "longseq" in _SECT:
     out["long_seq_attention"] = ls
+    done("longseq")
     print("STEP longseq", flush=True)
+    part()
+
+# --- attention block-size tuning (opt-in section "tune") ------------
+# The VERDICT r04 MFU target (>=0.45 on the 1B proxy) needs the flash
+# kernel as fast as it can go; block_q/block_k set the VMEM working
+# set and MXU utilization. Not in the default section list — run with
+# TDR_EXTRA_SECTIONS=tune when a window allows.
+if "tune" in _SECT:
+    from rocnrdma_tpu.ops.attention import flash_attention as _fa
+    kq3, kk3, kv3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    qt = jax.random.normal(kq3, (1, 16, 2048, 128), jnp.bfloat16)
+    kt = jax.random.normal(kk3, (1, 8, 2048, 128), jnp.bfloat16)
+    vt = jax.random.normal(kv3, (1, 8, 2048, 128), jnp.bfloat16)
+    tune = {}
+    for bq, bk in ((128, 128), (128, 256), (256, 128), (256, 256),
+                   (512, 128), (256, 512), (512, 256), (512, 512)):
+        try:
+            f = jax.jit(lambda q_, k_, v_, bq_=bq, bk_=bk: _fa(
+                q_, k_, v_, True, block_q=bq_, block_k=bk_))
+            t, _ = timeit(f, qt, kt, vt, reps=10)
+            tune[f"fwd_bq{bq}_bk{bk}_us"] = round(t * 1e6, 1)
+        except Exception as e:
+            tune[f"fwd_bq{bq}_bk{bk}_us"] = f"failed: {type(e).__name__}"
+        try:
+            g = jax.jit(jax.grad(
+                lambda q_, k_, v_, bq_=bq, bk_=bk: _fa(
+                    q_, k_, v_, True, block_q=bq_,
+                    block_k=bk_).astype(jnp.float32).sum(),
+                argnums=(0, 1, 2)))
+            t, _ = timeit(g, qt, kt, vt, reps=5)
+            tune[f"grad_bq{bq}_bk{bk}_us"] = round(t * 1e6, 1)
+        except Exception as e:
+            tune[f"grad_bq{bq}_bk{bk}_us"] = f"failed: {type(e).__name__}"
+    out["attn_block_tuning"] = tune
+    del qt, kt, vt
+    gc.collect()
+    done("tune")
+    print("STEP tune", flush=True)
     part()
 
 # --- incremental decode (generate() KV-cache path) ------------------
@@ -222,6 +271,7 @@ if "decode" in _SECT:
         dt = time.perf_counter() - t0
         dec[f"tokens_per_s_{n}new"] = round(n / dt, 1)
     out["llama3_1b_decode"] = dec
+    done("decode")
     print("STEP decode", flush=True)
 
 print("TPUBENCH " + json.dumps(out), flush=True)
@@ -289,9 +339,12 @@ def main():
                 if new_partial is not None:
                     merged["partial"] = new_partial
                 merged["_steps"] = prev.get("_steps", 0) + results["_steps"]
-                merged["sections"] = sorted(
-                    set(prev.get("sections", [])) |
-                    set(results.get("sections", [])))
+                merged["sections_completed"] = sorted(
+                    set(prev.get("sections_completed", [])) |
+                    set(results.get("sections_completed", [])))
+                merged["sections_requested"] = sorted(
+                    set(prev.get("sections_requested", [])) |
+                    set(results.get("sections_requested", [])))
                 merged["_runs"] = runs + [results.get("ts")]
                 results = merged
             except Exception:  # noqa: BLE001 — unreadable prev: replace
