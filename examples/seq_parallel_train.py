@@ -66,20 +66,27 @@ def main():
             raise
 
     t0 = time.perf_counter()
-    ts = [threading.Thread(target=run_rank, args=(r,)) for r in range(W)]
+    # daemon=True: a failed rank leaves its peers blocked in the ring;
+    # daemon threads can't keep the interpreter alive at exit, so the
+    # error path below is actually terminal instead of hanging in
+    # shutdown.
+    ts = [threading.Thread(target=run_rank, args=(r,), daemon=True)
+          for r in range(W)]
     for t in ts:
         t.start()
-    # A failed rank would leave its peers blocked in the ring; join
-    # with a timeout and surface the first traceback instead of
-    # hanging silently.
-    for t in ts:
-        t.join(timeout=600)
+    while any(t.is_alive() for t in ts) and not errs:
+        for t in ts:
+            t.join(timeout=1)
     dt = time.perf_counter() - t0
-    for w in worlds:
-        w.close()
     if errs:
+        # Close the worlds FIRST — peers blocked in ring waits flush
+        # out with transport errors instead of being waited on.
+        for w in worlds:
+            w.close()
         sys.stderr.write(errs[0])
         return 1
+    for w in worlds:
+        w.close()
 
     assert all(ls is not None for ls in losses)
     for ls in losses[1:]:  # every rank reports the same global loss

@@ -278,6 +278,53 @@ print("TPUBENCH " + json.dumps(out), flush=True)
 """
 
 
+# Section → the bank key whose presence proves that section completed
+# at least once (used for the merged bank's completeness annotation).
+SECTION_KEYS = {"entry": "entry_auto_pallas_compiles",
+                "ops": "attn_h16kv8s2048d128_us",
+                "train": "llama3_1b_train_mfu_pallas",
+                "longseq": "long_seq_attention",
+                "decode": "llama3_1b_decode"}
+
+
+def merge_bank(prev: dict, results: dict) -> dict:
+    """MERGE a run's results into the existing bank rather than
+    competing with it: with section gating (TDR_EXTRA_SECTIONS) a
+    later window measures only what is still missing, so previously
+    banked keys must survive and re-measured keys must win. "partial"
+    reflects only the NEWEST run (nothing is lost by a partial — its
+    completed sections merged in); requested/completed section lists
+    and step counts accumulate across runs (_runs lists every
+    contributing run's timestamp)."""
+    prev = dict(prev)
+    results = dict(results)
+    runs = prev.pop("_runs", [prev.get("ts")])
+    prev.pop("partial", None)
+    prev.pop("missing_sections", None)
+    new_partial = results.pop("partial", None)
+    merged = {**prev, **results}
+    if new_partial is not None:
+        merged["partial"] = new_partial
+    merged["_steps"] = prev.get("_steps", 0) + results.get("_steps", 0)
+    for key in ("sections_completed", "sections_requested"):
+        merged[key] = sorted(
+            set(prev.get(key, [])) | set(results.get(key, [])))
+    merged["_runs"] = runs + [results.get("ts")]
+    return merged
+
+
+def annotate_missing(results: dict) -> dict:
+    """Completeness is a property of the MERGED bank, independent of
+    which runs contributed: a bank with no "partial" marker but
+    missing sections must still say so (a selective run that
+    completes cleanly must not make an incomplete bank look whole)."""
+    results.pop("missing_sections", None)
+    missing = [s for s, k in SECTION_KEYS.items() if k not in results]
+    if missing:
+        results["missing_sections"] = sorted(missing)
+    return results
+
+
 def main():
     timeout_s = int(os.environ.get("TDR_CHASE_TIMEOUT_S", "1200"))
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
@@ -323,45 +370,14 @@ def main():
         f.write(json.dumps(rec) + "\n")
     if results is not None:
         results["_steps"] = rec.get("steps", 0)
-        # MERGE into the existing bank rather than compete with it:
-        # with section gating (TDR_EXTRA_SECTIONS) a later window
-        # measures only what is still missing, so previously banked
-        # keys must survive and re-measured keys must win.
         if os.path.exists(RESULTS):
             try:
                 with open(RESULTS) as f:
                     prev = json.load(f)
-                runs = prev.pop("_runs", [prev.get("ts")])
-                prev.pop("partial", None)
-                prev.pop("missing_sections", None)
-                new_partial = results.pop("partial", None)
-                merged = {**prev, **results}
-                if new_partial is not None:
-                    merged["partial"] = new_partial
-                merged["_steps"] = prev.get("_steps", 0) + results["_steps"]
-                merged["sections_completed"] = sorted(
-                    set(prev.get("sections_completed", [])) |
-                    set(results.get("sections_completed", [])))
-                merged["sections_requested"] = sorted(
-                    set(prev.get("sections_requested", [])) |
-                    set(results.get("sections_requested", [])))
-                merged["_runs"] = runs + [results.get("ts")]
-                results = merged
+                results = merge_bank(prev, results)
             except Exception:  # noqa: BLE001 — unreadable prev: replace
                 pass
-        # Completeness is a property of the MERGED bank, independent
-        # of which runs contributed: a bank with no "partial" marker
-        # but missing sections must still say so (a selective run that
-        # completes cleanly must not make an incomplete bank look
-        # whole).
-        section_keys = {"entry": "entry_auto_pallas_compiles",
-                        "ops": "attn_h16kv8s2048d128_us",
-                        "train": "llama3_1b_train_mfu_pallas",
-                        "longseq": "long_seq_attention",
-                        "decode": "llama3_1b_decode"}
-        missing = [s for s, k in section_keys.items() if k not in results]
-        if missing:
-            results["missing_sections"] = sorted(missing)
+        annotate_missing(results)
         with open(RESULTS, "w") as f:
             json.dump(results, f, indent=1)
         print("banked:", RESULTS)
