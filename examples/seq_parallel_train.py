@@ -74,19 +74,26 @@ def main():
           for r in range(W)]
     for t in ts:
         t.start()
-    while any(t.is_alive() for t in ts) and not errs:
+    # Bounded wait that also reacts to the FIRST rank error: a raised
+    # rank stops the wait immediately; a silent stall (no exception,
+    # e.g. a wedged transport) trips the deadline instead of hanging
+    # forever.
+    deadline = time.perf_counter() + 600
+    while (any(t.is_alive() for t in ts) and not errs
+           and time.perf_counter() < deadline):
         for t in ts:
             t.join(timeout=1)
     dt = time.perf_counter() - t0
-    if errs:
-        # Close the worlds FIRST — peers blocked in ring waits flush
-        # out with transport errors instead of being waited on.
-        for w in worlds:
-            w.close()
-        sys.stderr.write(errs[0])
-        return 1
+    stalled = any(t.is_alive() for t in ts) and not errs
+    # Close the worlds before reporting — peers blocked in ring waits
+    # flush out with transport errors instead of being waited on (the
+    # rank threads are daemons, so they cannot block interpreter exit).
     for w in worlds:
         w.close()
+    if errs or stalled:
+        sys.stderr.write(errs[0] if errs
+                         else "rank(s) stalled past the 600s deadline\n")
+        return 1
 
     assert all(ls is not None for ls in losses)
     for ls in losses[1:]:  # every rank reports the same global loss

@@ -152,6 +152,60 @@ def test_ring_attention_charges_staging_and_reports_wait():
         w.close()
 
 
+def test_returned_gradients_do_not_alias_rotation_buffers():
+    """The arrays backward() returns must be SNAPSHOTS: jax's CPU
+    backend zero-copy-aliases 64-byte-aligned numpy memory (alignment
+    of np.empty varies per allocation — which made the original bug a
+    load-dependent flake), and the next call on the same instance
+    zeroes and rotates those very bytes. Regression: zero the
+    registered buffers after backward returns but BEFORE materializing
+    the gradients; aliased returns would read zeros."""
+    from rocnrdma_tpu.collectives.ring_attention import RingAttention
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    rng = np.random.default_rng(9)
+    world_size, s_local, h, d = 2, 16, 2, 16
+    S = world_size * s_local
+    q = rng.standard_normal((1, h, S, d)).astype(np.float32)
+    do = rng.standard_normal((1, h, S, d)).astype(np.float32)
+    worlds = local_worlds(world_size, free_port() + 970)
+    ras = [RingAttention(worlds[r], interpret=True)
+           for r in range(world_size)]
+    grads = [None] * world_size
+    errs = []
+
+    def go(r):
+        try:
+            sl = slice(r * s_local, (r + 1) * s_local)
+            qs, dos = q[:, :, sl], do[:, :, sl]
+            out, lse = ras[r].forward(qs, qs, qs, causal=True)
+            g = ras[r].backward(qs, qs, qs, out, lse, dos, causal=True)
+            # Clobber the rotation buffers while the returned arrays
+            # are still unmaterialized — the hazard window.
+            for b in ras[r]._bufs:
+                b[:] = 0
+            grads[r] = tuple(np.asarray(x).copy() for x in g)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=go, args=(r,))
+          for r in range(world_size)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    for r in range(world_size):
+        # dk/dv of a real shard can't be all-zero; aliased returns
+        # would have read the zeroed buffer.
+        assert np.any(grads[r][1] != 0), "dk aliased the zeroed buffer"
+        assert np.any(grads[r][2] != 0), "dv aliased the zeroed buffer"
+    for ra in ras:
+        ra.close()
+    for w in worlds:
+        w.close()
+
+
 def test_ring_attention_posts_only_work_requests():
     """Front-loaded registration (the reference invariant): after the
     first call, a second call registers nothing new — the rotation

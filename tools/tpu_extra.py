@@ -2,7 +2,7 @@
 """Follow-up TPU measurements, run while the flaky tunnel is alive.
 
 tools/tpu_chase.py banks the first successful core bench into
-TPU_RESULTS_r04.json; this script opportunistically deepens it:
+TPU_RESULTS_<round>.json; this script opportunistically deepens it:
 
 - ``entry()`` compile check with the production defaults (Pallas auto
   → ON for the TPU backend) — proves the driver's single-chip gate
@@ -13,9 +13,11 @@ TPU_RESULTS_r04.json; this script opportunistically deepens it:
 - op-level Pallas-vs-XLA timing + on-device parity for rmsnorm and
   flash attention at Llama-3-1B shapes.
 
-Results append one line to TPU_ATTEMPTS_r04.jsonl and, on success,
-write TPU_RESULTS_r04_extra.json; bench.py folds both banked files
-into its output.
+Results append one line to TPU_ATTEMPTS_<round>.jsonl and, on
+success, MERGE into TPU_RESULTS_<round>_extra.json (see merge_bank);
+bench.py folds the banked files into its output. TDR_EXTRA_SECTIONS
+selects sections (entry,ops,train,longseq,decode + opt-in tune) so a
+short tunnel window can be spent on exactly what is still missing.
 """
 import json
 import os
