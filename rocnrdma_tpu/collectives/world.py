@@ -83,6 +83,22 @@ class RingWorld:
         """In-place ring allreduce of a C-contiguous numpy array."""
         self.ring.allreduce(array, op)
 
+    def reduce_scatter(self, array, op: int = RED_SUM) -> slice:
+        """In-place reduce-scatter; returns the element slice this
+        rank owns afterwards (allreduce ≡ reduce_scatter then
+        all_gather on the same buffer)."""
+        return self.ring.reduce_scatter(array, op)
+
+    def all_gather(self, array) -> None:
+        """In-place all-gather of per-rank owned segments (the layout
+        ``reduce_scatter`` leaves)."""
+        self.ring.all_gather(array)
+
+    def broadcast(self, array, root: int = 0) -> None:
+        """Broadcast root's buffer to every rank (store-and-forward
+        chunk pipeline down the ring)."""
+        self.ring.broadcast(array, root)
+
     def _dg_hop(self, send_len: int, timeout: int, what: str) -> None:
         """One neighbor hop of the digest protocol: recv ``send_len``
         bytes from the left while sending the same from the right."""

@@ -195,6 +195,19 @@ tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
                           int rank, int world);
 int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                        int red_op);
+/* The rest of the MPI-app collective surface, sharing the
+ * allreduce's segment layout and ownership convention:
+ * reduce_scatter is its phase 1 — on return this rank's OWNED range
+ * (the fully-reduced segment (rank+1) % world) is reported via
+ * own_off/own_len (byte offset/length into data; either may be
+ * NULL); all_gather is its phase 2 and assumes that same ownership;
+ * broadcast streams root's nbytes down the ring, store-and-forward
+ * per chunk. allreduce ≡ reduce_scatter; all_gather. */
+int tdr_ring_reduce_scatter(tdr_ring *r, void *data, size_t count,
+                            int dtype, int red_op, size_t *own_off,
+                            size_t *own_len);
+int tdr_ring_all_gather(tdr_ring *r, void *data, size_t count, int dtype);
+int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root);
 /* Front-load registration for a caller-stable buffer; allreduces on it
  * post work requests only. Unregistered buffers are registered per
  * call (safe for arbitrary/recycled addresses, slower). */

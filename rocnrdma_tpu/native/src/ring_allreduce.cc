@@ -664,6 +664,66 @@ struct Wavefront {
 };
 
 
+// Segment layout shared by allreduce and the standalone phases:
+// world segments, first `rem` get one extra element.
+void seg_layout(int world, size_t count, size_t esz,
+                std::vector<size_t> *off, std::vector<size_t> *len) {
+  off->resize(world);
+  len->resize(world);
+  size_t base = count / world, rem = count % world;
+  size_t o = 0;
+  for (int i = 0; i < world; i++) {
+    (*off)[i] = o * esz;
+    (*len)[i] = (base + (static_cast<size_t>(i) < rem ? 1 : 0)) * esz;
+    o += base + (static_cast<size_t>(i) < rem ? 1 : 0);
+  }
+}
+
+// Deregister a per-call (non-front-loaded) data MR on scope exit.
+struct OwnedMrGuard {
+  tdr_mr *mr;
+  bool active;
+  ~OwnedMrGuard() {
+    if (active && mr) tdr_dereg_mr(mr);
+  }
+};
+
+// The generic schedule's two phases, shared verbatim between
+// allreduce and the standalone reduce_scatter/all_gather so the
+// documented bit-for-bit composition identity cannot drift.
+// Phase 1: reduce-scatter. After step s, segment (rank-s-1) holds the
+// partial sum of s+2 ranks; after world-1 steps each rank owns the
+// full reduction of segment (rank+1) mod world.
+int run_rs_phase(StepPipe &pipe, tdr_ring *r,
+                 const std::vector<size_t> &seg_off,
+                 const std::vector<size_t> &seg_len) {
+  const int world = r->world;
+  for (int s = 0; s < world - 1; s++) {
+    int send_seg = ((r->rank - s) % world + world) % world;
+    int recv_seg = ((r->rank - s - 1) % world + world) % world;
+    if (pipe.run(seg_off[send_seg], seg_len[send_seg], seg_off[recv_seg],
+                 seg_len[recv_seg], /*reduce=*/true) != 0)
+      return -1;
+  }
+  return 0;
+}
+
+// Phase 2: all-gather — fully-reduced segments circulate; received
+// bytes land directly in the data MR (no scratch, no extra copy).
+int run_ag_phase(StepPipe &pipe, tdr_ring *r,
+                 const std::vector<size_t> &seg_off,
+                 const std::vector<size_t> &seg_len) {
+  const int world = r->world;
+  for (int s = 0; s < world - 1; s++) {
+    int send_seg = ((r->rank + 1 - s) % world + world) % world;
+    int recv_seg = ((r->rank - s) % world + world) % world;
+    if (pipe.run(seg_off[send_seg], seg_len[send_seg], seg_off[recv_seg],
+                 seg_len[recv_seg], /*reduce=*/false) != 0)
+      return -1;
+  }
+  return 0;
+}
+
 }  // namespace
 
 int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
@@ -682,15 +742,8 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
   const int world = r->world;
   const size_t nbytes = count * esz;
 
-  // Segment layout: world segments, first `rem` get one extra element.
-  std::vector<size_t> seg_off(world), seg_len(world);
-  size_t base = count / world, rem = count % world;
-  size_t off = 0;
-  for (int i = 0; i < world; i++) {
-    seg_off[i] = off * esz;
-    seg_len[i] = (base + (static_cast<size_t>(i) < rem ? 1 : 0)) * esz;
-    off += base + (static_cast<size_t>(i) < rem ? 1 : 0);
-  }
+  std::vector<size_t> seg_off, seg_len;
+  seg_layout(world, count, esz, &seg_off, &seg_len);
 
   bool owned = false;
   tdr_mr *dmr = r->data_mr(data, nbytes, &owned);
@@ -709,13 +762,7 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
         "memory or use a host-staged collective");
     return -1;
   }
-  struct OwnedGuard {
-    tdr_mr *mr;
-    bool active;
-    ~OwnedGuard() {
-      if (active && mr) tdr_dereg_mr(mr);
-    }
-  } guard{dmr, owned};
+  OwnedMrGuard guard{dmr, owned};
   (void)guard;
 
   // World-2 fast path: phases overlapped chunk-wise (see FusedTwo).
@@ -816,26 +863,180 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
 
   r->last_sched = TDR_SCHED_GENERIC;
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};
+  if (run_rs_phase(pipe, r, seg_off, seg_len) != 0) return -1;
+  return run_ag_phase(pipe, r, seg_off, seg_len);
+}
 
-  // Phase 1: reduce-scatter. After step s, segment (rank-s-1) holds the
-  // partial sum of s+2 ranks; after world-1 steps each rank owns the
-  // full reduction of segment (rank+1) mod world.
-  for (int s = 0; s < world - 1; s++) {
-    int send_seg = ((r->rank - s) % world + world) % world;
-    int recv_seg = ((r->rank - s - 1) % world + world) % world;
-    if (pipe.run(seg_off[send_seg], seg_len[send_seg], seg_off[recv_seg],
-                 seg_len[recv_seg], /*reduce=*/true) != 0)
-      return -1;
+// ------------------------------------------------------------------
+// Standalone reduce-scatter / all-gather / broadcast — the rest of
+// the MPI-app collective surface (SURVEY §1 L5, README.md:64: "IB
+// Verbs interface must be used"; perftest/MPI consumers expect more
+// than allreduce). reduce_scatter/all_gather ARE the allreduce's two
+// generic phases (run_rs_phase/run_ag_phase — shared code, so the
+// bit-for-bit composition identity cannot drift), with the same
+// segment layout and the (rank+1) % world ownership convention.
+// They always run the barrier-stepped generic schedule; the fused
+// world-2 exchange and the flattened wavefront interleave the two
+// phases and so exist only for the full allreduce — callers hot
+// enough to care should call tdr_ring_allreduce, not the
+// composition (measured 1.53x at world 4, SWEEP_W4_r05.json).
+// ------------------------------------------------------------------
+
+int tdr_ring_reduce_scatter(tdr_ring *r, void *data, size_t count,
+                            int dtype, int red_op, size_t *own_off,
+                            size_t *own_len) {
+  if (!r || !data) {
+    tdr::set_error("ring_reduce_scatter: null ring or data");
+    return -1;
   }
+  size_t esz = dtype_size(dtype);
+  if (esz == 0) {
+    tdr::set_error("ring: bad dtype");
+    return -1;
+  }
+  std::lock_guard<std::mutex> g(r->mu);
+  const int world = r->world;
+  std::vector<size_t> seg_off, seg_len;
+  seg_layout(world, count, esz, &seg_off, &seg_len);
+  const int own = (r->rank + 1) % world;
+  if (own_off) *own_off = seg_off[own];
+  if (own_len) *own_len = seg_len[own];
+  if (count == 0 || world == 1) return 0;
+  bool owned = false;
+  tdr_mr *dmr = r->data_mr(data, count * esz, &owned);
+  if (!dmr) return -1;
+  OwnedMrGuard guard{dmr, owned};
+  (void)guard;
+  if (!tdr_mr_cpu_foldable(dmr)) {
+    tdr::set_error("ring_reduce_scatter: data MR has no CPU mapping");
+    return -1;
+  }
+  StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};
+  return run_rs_phase(pipe, r, seg_off, seg_len);
+}
 
-  // Phase 2: all-gather — fully-reduced segments circulate; received
-  // bytes land directly in the data MR (no scratch, no extra copy).
-  for (int s = 0; s < world - 1; s++) {
-    int send_seg = ((r->rank + 1 - s) % world + world) % world;
-    int recv_seg = ((r->rank - s) % world + world) % world;
-    if (pipe.run(seg_off[send_seg], seg_len[send_seg], seg_off[recv_seg],
-                 seg_len[recv_seg], /*reduce=*/false) != 0)
-      return -1;
+int tdr_ring_all_gather(tdr_ring *r, void *data, size_t count, int dtype) {
+  if (!r || !data) {
+    tdr::set_error("ring_all_gather: null ring or data");
+    return -1;
+  }
+  size_t esz = dtype_size(dtype);
+  if (esz == 0) {
+    tdr::set_error("ring: bad dtype");
+    return -1;
+  }
+  if (count == 0) return 0;
+  std::lock_guard<std::mutex> g(r->mu);
+  const int world = r->world;
+  if (world == 1) return 0;
+  std::vector<size_t> seg_off, seg_len;
+  seg_layout(world, count, esz, &seg_off, &seg_len);
+  bool owned = false;
+  tdr_mr *dmr = r->data_mr(data, count * esz, &owned);
+  if (!dmr) return -1;
+  OwnedMrGuard guard{dmr, owned};
+  (void)guard;
+  StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, TDR_RED_SUM, esz};
+  return run_ag_phase(pipe, r, seg_off, seg_len);
+}
+
+int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
+  if (!r || !data) {
+    tdr::set_error("ring_broadcast: null ring or data");
+    return -1;
+  }
+  std::lock_guard<std::mutex> g(r->mu);
+  const int world = r->world;
+  if (root < 0 || root >= world) {
+    tdr::set_error("ring_broadcast: bad root");
+    return -1;
+  }
+  if (nbytes == 0 || world == 1) return 0;
+  bool owned = false;
+  tdr_mr *dmr = r->data_mr(data, nbytes, &owned);
+  if (!dmr) return -1;
+  OwnedMrGuard guard{dmr, owned};
+  (void)guard;
+
+  // Store-and-forward pipeline down the ring: the root streams chunks
+  // rightward; middle ranks forward chunk i the moment its receive
+  // lands (bytes are final — each chunk is received exactly once, so
+  // the forwarding send may safely read the data MR); the last rank
+  // ((root-1+world) % world) only receives. Bandwidth-optimal for
+  // large messages, latency (world-1) extra chunks.
+  const size_t chunk = r->chunk;
+  const size_t n = (nbytes + chunk - 1) / chunk;
+  const int d = ((r->rank - root) % world + world) % world;
+  const bool recv_side = d != 0;
+  const bool send_side = d != world - 1;
+  auto clen = [&](size_t i) { return std::min(chunk, nbytes - i * chunk); };
+
+  size_t posted_r = 0, done_r = 0, posted_s = 0, acked_s = 0;
+  const size_t n_recv = recv_side ? n : 0;
+  const size_t n_send = send_side ? n : 0;
+  const bool same_qp = (r->left == r->right);
+  auto drain = [&](tdr_qp *qp, int timeout_ms) -> int {
+    tdr_wc wc[16];
+    int c = tdr_poll(qp, wc, 16, timeout_ms);
+    if (c < 0) return -1;
+    for (int i = 0; i < c; i++) {
+      if (wc[i].status != TDR_WC_SUCCESS) {
+        tdr::set_error("ring(bcast): completion error status " +
+                       std::to_string(wc[i].status));
+        return -1;
+      }
+      uint64_t kind = wc[i].wr_id & kWrKindMask;
+      if (kind == kWrSend) {
+        acked_s++;
+      } else if (kind == kWrRecv) {
+        size_t idx = wc[i].wr_id & ~kWrKindMask;
+        if (idx != done_r) {
+          tdr::set_error("ring(bcast): out-of-order recv completion");
+          return -1;
+        }
+        done_r++;
+      }
+    }
+    return c;
+  };
+
+  while (done_r < n_recv || acked_s < n_send) {
+    bool progressed = false;
+    while (posted_r < n_recv && posted_r - done_r < kMaxOutstanding) {
+      if (tdr_post_recv(r->left, dmr, posted_r * chunk, clen(posted_r),
+                        kWrRecv | posted_r) != 0)
+        return -1;
+      posted_r++;
+      progressed = true;
+    }
+    // Forwarding dependency: a non-root rank sends chunk i only after
+    // receiving it; the root has every chunk up front.
+    while (posted_s < n_send && posted_s - acked_s < kMaxOutstanding &&
+           (!recv_side || posted_s < done_r)) {
+      if (tdr_post_send(r->right, dmr, posted_s * chunk, clen(posted_s),
+                        kWrSend | posted_s) != 0)
+        return -1;
+      posted_s++;
+      progressed = true;
+    }
+    int nl = recv_side ? drain(r->left, 0) : 0;
+    if (nl < 0) return -1;
+    int nr = (send_side && !same_qp) ? drain(r->right, 0) : 0;
+    if (nr < 0) return -1;
+    if (nl > 0 || nr > 0) progressed = true;
+    if (!progressed) {
+      tdr_qp *qp = (recv_side && done_r < n_recv) ? r->left : r->right;
+      int c = drain(qp, ring_timeout_ms());
+      if (c < 0) return -1;
+      if (c == 0) {
+        tdr::set_error("ring(bcast): poll timeout (s " +
+                       std::to_string(acked_s) + "/" +
+                       std::to_string(n_send) + " r " +
+                       std::to_string(done_r) + "/" +
+                       std::to_string(n_recv) + ")");
+        return -1;
+      }
+    }
   }
   return 0;
 }

@@ -165,6 +165,19 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_allreduce.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
     ]
+    lib.tdr_ring_reduce_scatter.restype = ctypes.c_int
+    lib.tdr_ring_reduce_scatter.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.tdr_ring_all_gather.restype = ctypes.c_int
+    lib.tdr_ring_all_gather.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_int,
+    ]
+    lib.tdr_ring_broadcast.restype = ctypes.c_int
+    lib.tdr_ring_broadcast.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_int,
+    ]
     lib.tdr_ring_destroy.argtypes = [P]
 
 
@@ -417,19 +430,59 @@ class Ring:
     def allreduce(self, array, op: int = RED_SUM) -> None:
         """In-place allreduce of a C-contiguous numpy array (ctypes
         releases the GIL for the duration, so per-rank threads overlap)."""
-        import numpy as np
-
-        dt = _NUMPY_DTYPE_MAP.get(str(array.dtype))
-        if dt is None:
-            raise TransportError(f"unsupported dtype {array.dtype}")
-        if not array.flags["C_CONTIGUOUS"]:
-            raise TransportError("allreduce requires a C-contiguous array")
-        ptr = array.ctypes.data if isinstance(array, np.ndarray) else None
-        if ptr is None:
-            raise TransportError("allreduce requires a numpy array")
+        ptr, dt = self._array_args(array, "allreduce")
         rc = _load().tdr_ring_allreduce(_live(self._h, "ring_allreduce"),
                                         ptr, array.size, dt, op)
         _check(rc == 0, "ring_allreduce")
+
+    def _array_args(self, array, what: str, need_dtype: bool = True):
+        import numpy as np
+
+        dt = _NUMPY_DTYPE_MAP.get(str(array.dtype))
+        if dt is None and need_dtype:
+            raise TransportError(f"unsupported dtype {array.dtype}")
+        if not isinstance(array, np.ndarray) or \
+                not array.flags["C_CONTIGUOUS"]:
+            raise TransportError(f"{what} requires a C-contiguous "
+                                 "numpy array")
+        return array.ctypes.data, dt
+
+    def reduce_scatter(self, array, op: int = RED_SUM) -> slice:
+        """In-place ring reduce-scatter (the allreduce's phase 1).
+        Returns the ELEMENT slice of ``array`` this rank owns
+        afterwards — the fully-reduced segment (rank+1) % world; the
+        rest of the buffer holds partial sums. ``all_gather`` on the
+        same buffer completes the allreduce."""
+        ptr, dt = self._array_args(array, "reduce_scatter")
+        own_off = ctypes.c_size_t()
+        own_len = ctypes.c_size_t()
+        rc = _load().tdr_ring_reduce_scatter(
+            _live(self._h, "ring_reduce_scatter"), ptr, array.size, dt,
+            op, ctypes.byref(own_off), ctypes.byref(own_len))
+        _check(rc == 0, "ring_reduce_scatter")
+        isz = array.itemsize
+        return slice(own_off.value // isz,
+                     (own_off.value + own_len.value) // isz)
+
+    def all_gather(self, array) -> None:
+        """In-place ring all-gather (the allreduce's phase 2):
+        circulates each rank's owned segment — the (rank+1) % world
+        layout ``reduce_scatter`` leaves — until every rank holds the
+        full buffer."""
+        ptr, dt = self._array_args(array, "all_gather")
+        rc = _load().tdr_ring_all_gather(
+            _live(self._h, "ring_all_gather"), ptr, array.size, dt)
+        _check(rc == 0, "ring_all_gather")
+
+    def broadcast(self, array, root: int) -> None:
+        """Ring broadcast: root's buffer contents stream to every
+        rank, store-and-forward per chunk (bandwidth-optimal for
+        large messages; latency grows by world-1 chunks)."""
+        # Byte-oriented: any dtype broadcasts (no folds happen).
+        ptr, _ = self._array_args(array, "broadcast", need_dtype=False)
+        rc = _load().tdr_ring_broadcast(
+            _live(self._h, "ring_broadcast"), ptr, array.nbytes, root)
+        _check(rc == 0, "ring_broadcast")
 
     @property
     def last_schedule(self) -> int:

@@ -57,6 +57,74 @@ def test_allreduce_sum_f32(world_size, count):
         w.close()
 
 
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+@pytest.mark.parametrize("count", [7, 100003])
+def test_reduce_scatter_then_all_gather_equals_allreduce(world_size,
+                                                        count):
+    """The new standalone collectives compose: reduce_scatter leaves
+    each rank owning a fully-reduced segment (returned as a slice),
+    and all_gather on the same buffer completes the allreduce —
+    asserted bit-for-bit against a separate allreduce of the same
+    inputs (identical schedule ⇒ identical fp association order)."""
+    worlds = local_worlds(world_size, free_port() + 100)
+    rng = np.random.default_rng(1)
+    inputs = [rng.standard_normal(count).astype(np.float32)
+              for _ in range(world_size)]
+    expect = [x.copy() for x in inputs]
+    run_ranks(worlds, lambda w, r: w.allreduce(expect[r]))
+
+    bufs = [x.copy() for x in inputs]
+    owned = [None] * world_size
+
+    def rs(w, r):
+        owned[r] = w.reduce_scatter(bufs[r])
+
+    run_ranks(worlds, rs)
+    # Each rank's owned slice already equals the allreduced values,
+    # segments partition the buffer, and ownership rotates per the
+    # documented (rank+1) % world convention.
+    offs = sorted((owned[r].start, owned[r].stop)
+                  for r in range(world_size))
+    assert offs[0][0] == 0 and offs[-1][1] == count
+    assert all(a[1] == b[0] for a, b in zip(offs, offs[1:]))
+    for r in range(world_size):
+        np.testing.assert_array_equal(bufs[r][owned[r]],
+                                      expect[r][owned[r]])
+
+    run_ranks(worlds, lambda w, r: w.all_gather(bufs[r]))
+    for r in range(world_size):
+        np.testing.assert_array_equal(bufs[r], expect[r])
+    for w in worlds:
+        w.close()
+
+
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+def test_broadcast(world_size):
+    """Every rank ends with root's bytes; non-root inputs are
+    overwritten; non-trivial root exercises the forwarding chain."""
+    worlds = local_worlds(world_size, free_port() + 100)
+    root = world_size - 1
+    count = 100003
+    rng = np.random.default_rng(2)
+    rootbuf = rng.standard_normal(count).astype(np.float32)
+    bufs = [rootbuf.copy() if r == root else
+            np.zeros(count, dtype=np.float32)
+            for r in range(world_size)]
+
+    run_ranks(worlds, lambda w, r: w.broadcast(bufs[r], root=root))
+    for r in range(world_size):
+        np.testing.assert_array_equal(bufs[r], rootbuf)
+
+    # Arbitrary-dtype payload (broadcast is byte-oriented).
+    blobs = [np.frombuffer(b"rdma-bytes-%02d" % r, dtype=np.uint8).copy()
+             for r in range(world_size)]
+    run_ranks(worlds, lambda w, r: w.broadcast(blobs[r], root=0))
+    for r in range(world_size):
+        assert blobs[r].tobytes() == b"rdma-bytes-00"
+    for w in worlds:
+        w.close()
+
+
 @pytest.mark.parametrize("dtype", ["float64", "int32", "int64"])
 def test_allreduce_dtypes(dtype):
     worlds = local_worlds(2, free_port() + 100)
