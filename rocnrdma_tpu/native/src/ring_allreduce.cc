@@ -975,6 +975,11 @@ int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
   const size_t n_recv = recv_side ? n : 0;
   const size_t n_send = send_side ? n : 0;
   const bool same_qp = (r->left == r->right);
+  // Third sibling of StepPipe::run's and Wavefront::drain's
+  // completion routing — they differ exactly in recv handling
+  // (scratch-fold+repost / deferred-foldback mask / plain counter
+  // here); a change to the shared parts (status mapping, wr_id kind
+  // scheme) must touch all three.
   auto drain = [&](tdr_qp *qp, int timeout_ms) -> int {
     tdr_wc wc[16];
     int c = tdr_poll(qp, wc, 16, timeout_ms);

@@ -31,8 +31,11 @@ Replication contract: parameters and optimizer state are identical on
 every rank (same init seed, same averaged gradients, same update
 math), so ranks stay bit-synchronized without a parameter server.
 
-Not yet done here: activation rematerialization (the vjp pullbacks
-hold one layer of residuals each) and intra-rank tensor parallelism —
+Activation rematerialization: with ``remat=True`` (the production
+setting at sizes that matter, same flag as the DP trainer) the jitted
+halves are ``jax.checkpoint``-ed, so each layer's pullback residual
+shrinks to the half's inputs and the internals recompute during the
+backward sweep. Not yet done here: intra-rank tensor parallelism —
 the seq axis composes with the jit-internal dp/tp mesh of
 ``parallel/trainer.py`` in the usual grid fashion but this runner
 drives one device per rank.
@@ -75,6 +78,13 @@ class SeqParallelTrainer:
         self.model = make_model(config, **model_overrides)
         self.cfg = cfg = self.model.cfg
         self.world = world
+        # cfg.remat (the production setting for sizes that matter):
+        # wrap the jitted block halves in jax.checkpoint, so each
+        # layer's vjp residual shrinks to the half's INPUTS — the
+        # internal activations (rmsnorm intermediates, pre-rope q/k,
+        # the MLP's d_ff-wide hidden) are recomputed during the
+        # pullback instead of held across the whole backward sweep.
+        self._remat = bool(cfg.remat)
         if interpret is None:
             interpret = cfg.pallas_interpret
         self.ring_attention = RingAttention(world, interpret=interpret)
@@ -156,21 +166,31 @@ class SeqParallelTrainer:
         """(local_loss, grads): exact gradients of this rank's local
         mean loss chains — see the module docstring for why the
         mean-allreduce of these across ranks is the global-loss
-        gradient. Residual memory is one pullback per layer (no remat
-        yet)."""
+        gradient. Residual memory is one pullback per layer — inputs
+        only under remat, full half-internals otherwise."""
         p = params["params"]
         fr = self._freqs_shard(inputs.shape[1])
         x, pull_embed = jax.vjp(
             lambda ep: self._embed(ep, inputs), p["embed"])
+        # Under remat, differentiate through checkpointed halves: the
+        # pullback then holds only the half's inputs and re-runs its
+        # forward on demand (jit'd on first use, cached thereafter).
+        if self._remat:
+            qkv_fn = jax.checkpoint(
+                lambda lp_, x_, fr_: self._qkv(lp_, x_, fr_))
+            post_fn = jax.checkpoint(
+                lambda lp_, x_, o_: self._post(lp_, x_, o_))
+        else:
+            qkv_fn = lambda lp_, x_, fr_: self._qkv(lp_, x_, fr_)
+            post_fn = lambda lp_, x_, o_: self._post(lp_, x_, o_)
         pulls = []
         residuals = []
         for i in range(self.cfg.n_layers):
             lp = p[f"layer_{i}"]
             (q, k, v), pull_qkv = jax.vjp(
-                lambda lp_, x_: self._qkv(lp_, x_, fr), lp, x)
+                lambda lp_, x_: qkv_fn(lp_, x_, fr), lp, x)
             out, lse = self.ring_attention.forward(q, k, v, causal=True)
-            x, pull_post = jax.vjp(
-                lambda lp_, x_, o_: self._post(lp_, x_, o_), lp, x, out)
+            x, pull_post = jax.vjp(post_fn, lp, x, out)
             pulls.append((pull_qkv, pull_post))
             residuals.append((q, k, v, out, lse))
         loss, pull_head = jax.vjp(

@@ -449,10 +449,13 @@ class Ring:
 
     def reduce_scatter(self, array, op: int = RED_SUM) -> slice:
         """In-place ring reduce-scatter (the allreduce's phase 1).
-        Returns the ELEMENT slice of ``array`` this rank owns
-        afterwards — the fully-reduced segment (rank+1) % world; the
-        rest of the buffer holds partial sums. ``all_gather`` on the
-        same buffer completes the allreduce."""
+        Returns the FLAT-element slice this rank owns afterwards — the
+        fully-reduced segment (rank+1) % world; the rest of the buffer
+        holds partial sums. The slice indexes ``array.reshape(-1)``
+        (segmentation ignores dimensionality, exactly like allreduce's
+        reduction does); apply it to the flat view, not to axis 0 of a
+        multi-dimensional array. ``all_gather`` on the same buffer
+        completes the allreduce."""
         ptr, dt = self._array_args(array, "reduce_scatter")
         own_off = ctypes.c_size_t()
         own_len = ctypes.c_size_t()
