@@ -99,6 +99,24 @@ class RingWorld:
         chunk pipeline down the ring)."""
         self.ring.broadcast(array, root)
 
+    def barrier(self) -> None:
+        """Collective barrier: no rank returns before every rank has
+        entered. A world-element allreduce — every segment non-empty,
+        so each rank's result transitively depends on every other
+        rank's contribution (a 1-element reduce would leave the
+        zero-length-segment ranks free to return early). The buffer is
+        created and ring-registered once, so steady-state barriers
+        post work requests only (the front-loaded-registration
+        invariant)."""
+        buf = getattr(self, "_barrier_buf", None)
+        if buf is None:
+            buf = self._barrier_buf = np.zeros(self.world,
+                                               dtype=np.int32)
+            self.ring.register_buffer(buf)
+        else:
+            buf[:] = 0
+        self.ring.allreduce(buf)
+
     def _dg_hop(self, send_len: int, timeout: int, what: str) -> None:
         """One neighbor hop of the digest protocol: recv ``send_len``
         bytes from the left while sending the same from the right."""

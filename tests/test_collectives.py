@@ -125,6 +125,32 @@ def test_broadcast(world_size):
         w.close()
 
 
+@pytest.mark.parametrize("world_size", [2, 3])
+def test_barrier_blocks_until_all_ranks_enter(world_size):
+    """No rank may leave the barrier before the last rank enters:
+    rank 0 enters late, and every other rank's exit time must be
+    after rank 0's entry."""
+    import time
+
+    worlds = local_worlds(world_size, free_port() + 100)
+    enter0 = [None]
+    exits = [None] * world_size
+
+    def go(w, r):
+        if r == 0:
+            time.sleep(0.4)
+            enter0[0] = time.perf_counter()
+        w.barrier()
+        exits[r] = time.perf_counter()
+
+    run_ranks(worlds, go)
+    for r in range(1, world_size):
+        assert exits[r] >= enter0[0], (
+            f"rank {r} left the barrier before rank 0 entered")
+    for w in worlds:
+        w.close()
+
+
 @pytest.mark.parametrize("dtype", ["float64", "int32", "int64"])
 def test_allreduce_dtypes(dtype):
     worlds = local_worlds(2, free_port() + 100)
