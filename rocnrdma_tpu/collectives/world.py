@@ -99,6 +99,13 @@ class RingWorld:
         chunk pipeline down the ring)."""
         self.ring.broadcast(array, root)
 
+    def reduce(self, array, root: int = 0, op: int = RED_SUM) -> None:
+        """Root-reduce: root's buffer ends holding the reduction over
+        all ranks; non-root buffers are clobbered with the partials
+        that passed through them (use allreduce when every rank needs
+        the result intact)."""
+        self.ring.reduce(array, root, op)
+
     def barrier(self) -> None:
         """Collective barrier: no rank returns before every rank has
         entered. A world-element allreduce — every segment non-empty,

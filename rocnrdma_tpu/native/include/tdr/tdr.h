@@ -208,6 +208,13 @@ int tdr_ring_reduce_scatter(tdr_ring *r, void *data, size_t count,
                             size_t *own_len);
 int tdr_ring_all_gather(tdr_ring *r, void *data, size_t count, int dtype);
 int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root);
+/* Root-reduce: converging fold toward root (one N-byte pass per
+ * link, chunk-pipelined through the fused recv_reduce op). In-place
+ * and DESTRUCTIVE on non-root ranks: their buffers end holding the
+ * partial sums that passed through them; only root holds the full
+ * reduction. */
+int tdr_ring_reduce(tdr_ring *r, void *data, size_t count, int dtype,
+                    int red_op, int root);
 /* Front-load registration for a caller-stable buffer; allreduces on it
  * post work requests only. Unregistered buffers are registered per
  * call (safe for arbitrary/recycled addresses, slower). */

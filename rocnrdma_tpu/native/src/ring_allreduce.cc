@@ -16,6 +16,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <mutex>
 #include <unordered_map>
 #include <unordered_set>
@@ -940,6 +941,159 @@ int tdr_ring_all_gather(tdr_ring *r, void *data, size_t count, int dtype) {
   return run_ag_phase(pipe, r, seg_off, seg_len);
 }
 
+namespace {
+
+// Shared progress pump for the CHAIN collectives (broadcast down the
+// ring, reduce converging toward root): window-bounded recv posting
+// on the left QP, dependency-gated forwarding on the right (chunk i
+// forwards only after chunk i landed, unless this rank is the chain
+// head), opportunistic then blocking drains. The two callers differ
+// ONLY in how a recv posts (plain vs reduce-on-receive), the window
+// sizes, and the error label.
+struct ChainPump {
+  tdr_ring *r;
+  size_t n_recv, n_send;
+  size_t recv_win, send_win;
+  bool head;  // no upstream: sends gate on nothing
+  const char *label;
+
+  size_t posted_r = 0, done_r = 0, posted_s = 0, acked_s = 0;
+
+  int run(const std::function<int(size_t)> &post_recv,
+          const std::function<int(size_t)> &post_send) {
+    const bool same_qp = (r->left == r->right);
+    auto drain = [&](tdr_qp *qp, int timeout_ms) -> int {
+      tdr_wc wc[16];
+      int c = tdr_poll(qp, wc, 16, timeout_ms);
+      if (c < 0) return -1;
+      for (int i = 0; i < c; i++) {
+        if (wc[i].status != TDR_WC_SUCCESS) {
+          tdr::set_error(std::string(label) + ": completion error status " +
+                         std::to_string(wc[i].status));
+          return -1;
+        }
+        uint64_t kind = wc[i].wr_id & kWrKindMask;
+        if (kind == kWrSend) {
+          acked_s++;
+        } else if (kind == kWrRecv) {
+          size_t idx = wc[i].wr_id & ~kWrKindMask;
+          if (idx != done_r) {
+            tdr::set_error(std::string(label) +
+                           ": out-of-order recv completion");
+            return -1;
+          }
+          done_r++;
+        }
+      }
+      return c;
+    };
+
+    while (done_r < n_recv || acked_s < n_send) {
+      bool progressed = false;
+      while (posted_r < n_recv && posted_r - done_r < recv_win) {
+        if (post_recv(posted_r) != 0) return -1;
+        posted_r++;
+        progressed = true;
+      }
+      while (posted_s < n_send && posted_s - acked_s < send_win &&
+             (head || posted_s < done_r)) {
+        if (post_send(posted_s) != 0) return -1;
+        posted_s++;
+        progressed = true;
+      }
+      int nl = n_recv ? drain(r->left, 0) : 0;
+      if (nl < 0) return -1;
+      int nr = (n_send && !same_qp) ? drain(r->right, 0) : 0;
+      if (nr < 0) return -1;
+      if (nl > 0 || nr > 0) progressed = true;
+      if (!progressed) {
+        tdr_qp *qp = (done_r < n_recv) ? r->left : r->right;
+        int c = drain(qp, ring_timeout_ms());
+        if (c < 0) return -1;
+        if (c == 0) {
+          tdr::set_error(std::string(label) + ": poll timeout (s " +
+                         std::to_string(acked_s) + "/" +
+                         std::to_string(n_send) + " r " +
+                         std::to_string(done_r) + "/" +
+                         std::to_string(n_recv) + ")");
+          return -1;
+        }
+      }
+    }
+    return 0;
+  }
+};
+
+}  // namespace
+
+int tdr_ring_reduce(tdr_ring *r, void *data, size_t count, int dtype,
+                    int red_op, int root) {
+  if (!r || !data) {
+    tdr::set_error("ring_reduce: null ring or data");
+    return -1;
+  }
+  size_t esz = dtype_size(dtype);
+  if (esz == 0) {
+    tdr::set_error("ring: bad dtype");
+    return -1;
+  }
+  std::lock_guard<std::mutex> g(r->mu);
+  const int world = r->world;
+  if (root < 0 || root >= world) {
+    tdr::set_error("ring_reduce: bad root");
+    return -1;
+  }
+  if (count == 0 || world == 1) return 0;
+  const size_t nbytes = count * esz;
+  bool owned = false;
+  tdr_mr *dmr = r->data_mr(data, nbytes, &owned);
+  if (!dmr) return -1;
+  OwnedMrGuard guard{dmr, owned};
+  (void)guard;
+  if (!tdr_mr_cpu_foldable(dmr)) {
+    tdr::set_error("ring_reduce: data MR has no CPU mapping");
+    return -1;
+  }
+  if (!tdr_qp_has_recv_reduce(r->left)) {
+    // Only the RECEIVING side needs the fused op (a plain SEND
+    // matches a posted recv_reduce fine); both in-repo engines
+    // advertise it, so this guards future engines only.
+    tdr::set_error("ring_reduce: engine lacks reduce-on-receive");
+    return -1;
+  }
+
+  // Converging fold toward root, rightward along the ring: the chain
+  // head ((root+1) % world) streams its buffer right; every
+  // intermediate rank reduce-receives inbound chunks INTO its own
+  // buffer (the fused recv_reduce op — fold completion IS the recv
+  // completion) and forwards the folded chunk on; root only
+  // reduce-receives. One N-byte pass per link, chunk-pipelined.
+  // In-place and destructive on non-root ranks: their buffers end
+  // holding the partial sums that passed through them. Windows: recv
+  // bounded by OUR reduce-recv budget, sends by the downstream
+  // peer's (symmetric config).
+  const size_t chunk = r->chunk;
+  const size_t n = (nbytes + chunk - 1) / chunk;
+  const int d = ((r->rank - root) % world + world) % world;
+  auto clen = [&](size_t i) { return std::min(chunk, nbytes - i * chunk); };
+  ChainPump pump{r,
+                 /*n_recv=*/d != 1 ? n : 0,
+                 /*n_send=*/d != 0 ? n : 0,
+                 /*recv_win=*/reduce_recv_window(r->left),
+                 /*send_win=*/reduce_recv_window(r->right),
+                 /*head=*/d == 1,
+                 "ring(reduce)"};
+  return pump.run(
+      [&](size_t i) {
+        return tdr_post_recv_reduce(r->left, dmr, i * chunk, clen(i),
+                                    dtype, red_op, kWrRecv | i);
+      },
+      [&](size_t i) {
+        return tdr_post_send(r->right, dmr, i * chunk, clen(i),
+                             kWrSend | i);
+      });
+}
+
 int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
   if (!r || !data) {
     tdr::set_error("ring_broadcast: null ring or data");
@@ -967,83 +1121,23 @@ int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
   const size_t chunk = r->chunk;
   const size_t n = (nbytes + chunk - 1) / chunk;
   const int d = ((r->rank - root) % world + world) % world;
-  const bool recv_side = d != 0;
-  const bool send_side = d != world - 1;
   auto clen = [&](size_t i) { return std::min(chunk, nbytes - i * chunk); };
-
-  size_t posted_r = 0, done_r = 0, posted_s = 0, acked_s = 0;
-  const size_t n_recv = recv_side ? n : 0;
-  const size_t n_send = send_side ? n : 0;
-  const bool same_qp = (r->left == r->right);
-  // Third sibling of StepPipe::run's and Wavefront::drain's
-  // completion routing — they differ exactly in recv handling
-  // (scratch-fold+repost / deferred-foldback mask / plain counter
-  // here); a change to the shared parts (status mapping, wr_id kind
-  // scheme) must touch all three.
-  auto drain = [&](tdr_qp *qp, int timeout_ms) -> int {
-    tdr_wc wc[16];
-    int c = tdr_poll(qp, wc, 16, timeout_ms);
-    if (c < 0) return -1;
-    for (int i = 0; i < c; i++) {
-      if (wc[i].status != TDR_WC_SUCCESS) {
-        tdr::set_error("ring(bcast): completion error status " +
-                       std::to_string(wc[i].status));
-        return -1;
-      }
-      uint64_t kind = wc[i].wr_id & kWrKindMask;
-      if (kind == kWrSend) {
-        acked_s++;
-      } else if (kind == kWrRecv) {
-        size_t idx = wc[i].wr_id & ~kWrKindMask;
-        if (idx != done_r) {
-          tdr::set_error("ring(bcast): out-of-order recv completion");
-          return -1;
-        }
-        done_r++;
-      }
-    }
-    return c;
-  };
-
-  while (done_r < n_recv || acked_s < n_send) {
-    bool progressed = false;
-    while (posted_r < n_recv && posted_r - done_r < kMaxOutstanding) {
-      if (tdr_post_recv(r->left, dmr, posted_r * chunk, clen(posted_r),
-                        kWrRecv | posted_r) != 0)
-        return -1;
-      posted_r++;
-      progressed = true;
-    }
-    // Forwarding dependency: a non-root rank sends chunk i only after
-    // receiving it; the root has every chunk up front.
-    while (posted_s < n_send && posted_s - acked_s < kMaxOutstanding &&
-           (!recv_side || posted_s < done_r)) {
-      if (tdr_post_send(r->right, dmr, posted_s * chunk, clen(posted_s),
-                        kWrSend | posted_s) != 0)
-        return -1;
-      posted_s++;
-      progressed = true;
-    }
-    int nl = recv_side ? drain(r->left, 0) : 0;
-    if (nl < 0) return -1;
-    int nr = (send_side && !same_qp) ? drain(r->right, 0) : 0;
-    if (nr < 0) return -1;
-    if (nl > 0 || nr > 0) progressed = true;
-    if (!progressed) {
-      tdr_qp *qp = (recv_side && done_r < n_recv) ? r->left : r->right;
-      int c = drain(qp, ring_timeout_ms());
-      if (c < 0) return -1;
-      if (c == 0) {
-        tdr::set_error("ring(bcast): poll timeout (s " +
-                       std::to_string(acked_s) + "/" +
-                       std::to_string(n_send) + " r " +
-                       std::to_string(done_r) + "/" +
-                       std::to_string(n_recv) + ")");
-        return -1;
-      }
-    }
-  }
-  return 0;
+  ChainPump pump{r,
+                 /*n_recv=*/d != 0 ? n : 0,
+                 /*n_send=*/d != world - 1 ? n : 0,
+                 /*recv_win=*/kMaxOutstanding,
+                 /*send_win=*/kMaxOutstanding,
+                 /*head=*/d == 0,
+                 "ring(bcast)"};
+  return pump.run(
+      [&](size_t i) {
+        return tdr_post_recv(r->left, dmr, i * chunk, clen(i),
+                             kWrRecv | i);
+      },
+      [&](size_t i) {
+        return tdr_post_send(r->right, dmr, i * chunk, clen(i),
+                             kWrSend | i);
+      });
 }
 
 }  // extern "C"

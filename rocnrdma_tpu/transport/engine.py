@@ -178,6 +178,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_broadcast.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int,
     ]
+    lib.tdr_ring_reduce.restype = ctypes.c_int
+    lib.tdr_ring_reduce.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
     lib.tdr_ring_destroy.argtypes = [P]
 
 
@@ -476,6 +480,17 @@ class Ring:
         rc = _load().tdr_ring_all_gather(
             _live(self._h, "ring_all_gather"), ptr, array.size, dt)
         _check(rc == 0, "ring_all_gather")
+
+    def reduce(self, array, root: int, op: int = RED_SUM) -> None:
+        """Root-reduce: after the call ROOT's buffer holds the
+        reduction over all ranks. In-place and DESTRUCTIVE on
+        non-root ranks (their buffers end holding the partial sums
+        that passed through them on the way to root); one buffer-pass
+        per link, folds riding the fused reduce-on-receive op."""
+        ptr, dt = self._array_args(array, "reduce")
+        rc = _load().tdr_ring_reduce(
+            _live(self._h, "ring_reduce"), ptr, array.size, dt, op, root)
+        _check(rc == 0, "ring_reduce")
 
     def broadcast(self, array, root: int) -> None:
         """Ring broadcast: root's buffer contents stream to every

@@ -125,6 +125,38 @@ def test_broadcast(world_size):
         w.close()
 
 
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+@pytest.mark.parametrize("root", [0, "last"])
+def test_root_reduce(world_size, root):
+    """Root's buffer ends holding the full sum (exactly the ring fold
+    order — compared against a sequential fold in chain order, which
+    is bit-identical for the converging schedule); non-root buffers
+    are documented-destructive, so only root is asserted. max-reduce
+    covered at root 0."""
+    root = world_size - 1 if root == "last" else root
+    worlds = local_worlds(world_size, free_port() + 100)
+    count = 100003
+    rng = np.random.default_rng(4)
+    inputs = [rng.standard_normal(count).astype(np.float32)
+              for _ in range(world_size)]
+    # Chain fold order: head = (root+1) % world, then rightward.
+    want = inputs[(root + 1) % world_size].copy()
+    for d in range(2, world_size + 1):
+        want = want + inputs[(root + d) % world_size]
+
+    bufs = [x.copy() for x in inputs]
+    run_ranks(worlds, lambda w, r: w.reduce(bufs[r], root=root))
+    np.testing.assert_array_equal(bufs[root], want)
+
+    if root == 0:
+        bufs = [x.copy() for x in inputs]
+        run_ranks(worlds,
+                  lambda w, r: w.reduce(bufs[r], root=0, op=RED_MAX))
+        np.testing.assert_array_equal(bufs[0], np.max(inputs, axis=0))
+    for w in worlds:
+        w.close()
+
+
 @pytest.mark.parametrize("world_size", [2, 3])
 def test_barrier_blocks_until_all_ranks_enter(world_size):
     """No rank may leave the barrier before the last rank enters:
