@@ -1,9 +1,10 @@
-"""tdr_allreduce — cross-host ring-allreduce benchmark (config 3).
+"""tdr_allreduce — cross-host ring-collective benchmark (config 3).
 
 The collective-level counterpart of ``tools.perf``: brings up an
-N-rank ring over the transport and measures allreduce bus bandwidth
-(the BASELINE.md config-3 metric; 2*(world-1)/world of the buffer
-crosses each rank's link per op).
+N-rank ring over the transport and measures collective bus bandwidth
+(default op: allreduce, the BASELINE.md config-3 metric;
+--op also runs reduce_scatter / all_gather / broadcast / reduce,
+each with its own useful-bytes convention).
 
 Single machine, all ranks in one process (threads):
 
@@ -28,18 +29,38 @@ import numpy as np
 from rocnrdma_tpu.tools.perf import parse_sizes
 
 
-def run_rank(world_obj, count: int, dtype, iters: int, barrier=None):
+def run_rank(world_obj, count: int, dtype, iters: int, barrier=None,
+             op: str = "allreduce"):
     buf = np.ones(count, dtype=dtype)
     world_obj.ring.register_buffer(buf)
-    world_obj.allreduce(buf)  # warmup (+ peers' MR setup)
+    coll = {
+        "allreduce": lambda: world_obj.allreduce(buf),
+        "reduce_scatter": lambda: world_obj.reduce_scatter(buf),
+        "all_gather": lambda: world_obj.all_gather(buf),
+        "broadcast": lambda: world_obj.broadcast(buf, root=0),
+        "reduce": lambda: world_obj.reduce(buf, root=0),
+    }[op]
+    coll()  # warmup (+ peers' MR setup)
     if barrier is not None:
         barrier.wait()
     t0 = time.perf_counter()
     for _ in range(iters):
-        world_obj.allreduce(buf)
+        coll()
     dt = (time.perf_counter() - t0) / iters
     world_obj.ring.unregister_buffer(buf)
     return dt
+
+
+# Useful bytes crossing each rank's link per op, as a fraction of the
+# buffer (standard bus-bandwidth conventions).
+def bus_fraction(op: str, world: int) -> float:
+    if op == "allreduce":
+        return 2.0 * (world - 1) / world
+    if op in ("reduce_scatter", "all_gather"):
+        return float(world - 1) / world
+    if op in ("broadcast", "reduce"):
+        return 1.0  # the whole buffer crosses each link
+    raise ValueError(f"no bus convention for op {op!r}")
 
 
 def main(argv=None):
@@ -55,6 +76,9 @@ def main(argv=None):
                     choices=["float32", "float64", "int32", "int64",
                              "bfloat16"])
     ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--op", default="allreduce",
+                    choices=["allreduce", "reduce_scatter", "all_gather",
+                             "broadcast", "reduce"])
     ap.add_argument("--engine", default=None)
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
@@ -83,7 +107,8 @@ def main(argv=None):
         out = [0.0] * world
 
         def go(r):
-            out[r] = run_rank(worlds[r], count, dtype, args.iters, barrier)
+            out[r] = run_rank(worlds[r], count, dtype, args.iters, barrier,
+                              args.op)
 
         ts = [threading.Thread(target=go, args=(r,)) for r in range(world)]
         for t in ts:
@@ -97,18 +122,29 @@ def main(argv=None):
         peers = args.peers.split(",") if args.peers else None
         w = RingWorld(Engine(spec), args.rank, world, args.port,
                       peers=peers)
-        dt = run_rank(w, count, dtype, args.iters)
+        dt = run_rank(w, count, dtype, args.iters, op=args.op)
+        if args.op in ("broadcast", "reduce"):
+            # Root-asymmetric ops: per-rank wall clocks legitimately
+            # differ (root finishes its sends before the chain tail
+            # lands; non-root reduce ranks time only their forwarding
+            # leg). Take the collective's true wall time as the max
+            # across ranks — a barrier'd re-run timed end to end.
+            w.barrier()
+            t0 = time.perf_counter()
+            run_rank(w, count, dtype, args.iters, op=args.op)
+            w.barrier()
+            dt = (time.perf_counter() - t0) / args.iters
         w.close()
 
     payload = count * dtype.itemsize
-    bus = payload * 2 * (world - 1) / world / dt / 1e9
-    result = {"world": world, "bytes": payload, "dtype": args.dtype,
-              "iters": args.iters, "sec_per_op": round(dt, 4),
-              "bus_GBps": round(bus, 3)}
+    bus = payload * bus_fraction(args.op, world) / dt / 1e9
+    result = {"op": args.op, "world": world, "bytes": payload,
+              "dtype": args.dtype, "iters": args.iters,
+              "sec_per_op": round(dt, 4), "bus_GBps": round(bus, 3)}
     if args.json:
         print(json.dumps(result))
     else:
-        print(f"allreduce {payload} B x{world} ranks: {dt*1e3:.1f} ms/op, "
+        print(f"{args.op} {payload} B x{world} ranks: {dt*1e3:.1f} ms/op, "
               f"bus {bus:.2f} GB/s")
     return 0
 
