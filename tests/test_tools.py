@@ -83,6 +83,29 @@ def test_requested_vs_completed_stay_separate():
     assert "ops" in m["missing_sections"]
 
 
+def test_collective_cli_runs_every_op():
+    """The collective benchmark CLI (the perftest/MPI-analogue role)
+    runs every primitive in-process and reports the op it ran with a
+    finite bandwidth."""
+    import json
+
+    from rocnrdma_tpu.tools import allreduce as cli
+
+    for i, op in enumerate(("allreduce", "reduce_scatter", "all_gather",
+                            "broadcast", "reduce")):
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli.main(["--world", "2", "--bytes", "1M", "--iters",
+                           "1", "--op", op, "--json",
+                           "--port", str(20900 + 17 * i + os.getpid() % 97)])
+        assert rc == 0
+        out = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert out["op"] == op and out["bus_GBps"] > 0
+
+
 def test_bench_snippet_compiles_and_is_section_complete():
     """The in-subprocess BENCH script must stay syntactically valid
     (percent-formatting included) and gate every section it reports."""
