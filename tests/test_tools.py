@@ -7,7 +7,10 @@ bank, and always attribute what actually executed (VERDICT r04
 missing-1 discipline).
 """
 
+import contextlib
 import importlib.util
+import io
+import json
 import os
 import sys
 
@@ -87,20 +90,17 @@ def test_collective_cli_runs_every_op():
     """The collective benchmark CLI (the perftest/MPI-analogue role)
     runs every primitive in-process and reports the op it ran with a
     finite bandwidth."""
-    import json
+    from test_transport import free_port
 
     from rocnrdma_tpu.tools import allreduce as cli
 
-    for i, op in enumerate(("allreduce", "reduce_scatter", "all_gather",
-                            "broadcast", "reduce")):
-        import contextlib
-        import io
-
+    for op in ("allreduce", "reduce_scatter", "all_gather",
+               "broadcast", "reduce"):
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
             rc = cli.main(["--world", "2", "--bytes", "1M", "--iters",
                            "1", "--op", op, "--json",
-                           "--port", str(20900 + 17 * i + os.getpid() % 97)])
+                           "--port", str(free_port())])
         assert rc == 0
         out = json.loads(buf.getvalue().strip().splitlines()[-1])
         assert out["op"] == op and out["bus_GBps"] > 0
