@@ -293,7 +293,10 @@ def test_schedule_mismatch_world3_all_ranks_fail_fast():
     t0 = time.perf_counter()
     run_ranks(worlds, step)
     dt = time.perf_counter() - t0
-    assert dt < 10, f"took {dt:.1f}s — some rank stalled"
+    # Bound chosen well under the 30 s ring stall timeout this test
+    # distinguishes fail-fast from, with headroom for full-suite load
+    # on the 1-vCPU CI box (observed 10.x s there; ~1 s standalone).
+    assert dt < 20, f"took {dt:.1f}s — some rank stalled"
     assert all(errs), errs
     # Rank 1 (left neighbor rank 0 matches it) learns via the status.
     assert "reported by a peer" in str(errs[1])
