@@ -155,8 +155,8 @@ def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
     n = nbytes // 4 // leaves
     out = {}
     try:
-      for mode, env in (("pipelined", "0"), ("serial", "1")):
-        os.environ["TDR_NO_STAGE_PIPELINE"] = env
+      for mode, pipe in (("pipelined", "1"), ("serial", "0")):
+        os.environ["TDR_STAGE_PIPELINE"] = pipe
         worlds = local_worlds(2, _free_port())
         shims = [CrossSliceAllReduce(worlds[r]) for r in range(2)]
         trees = [[np.ones(n, dtype=np.float32) for _ in range(leaves)]
@@ -183,7 +183,7 @@ def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
         for w in worlds:
             w.close()
     finally:
-      os.environ.pop("TDR_NO_STAGE_PIPELINE", None)
+      os.environ.pop("TDR_STAGE_PIPELINE", None)
     # On this 1-vCPU host pipelined ≈ serial by construction: the
     # D2H gather, ring, and H2D scatter are all CPU work sharing one
     # core, so there is nothing to overlap WITH. The pipeline pays on
@@ -280,12 +280,22 @@ n_params = model.cfg.param_count()
 seq = 2048
 tokens = jnp.ones((1, seq), dtype=jnp.int32)
 fwd = jax.jit(lambda p, t: model.apply(p, t))
-fwd(params, tokens).block_until_ready()
+# block_until_ready is not a trustworthy fence on this tunnel (see
+# tools/tpu_extra.py); materialize one element to force completion.
+def _sync(r):
+    leaf = jax.tree_util.tree_leaves(r)[0]
+    if getattr(leaf, "ndim", 0):
+        leaf = leaf[(0,) * leaf.ndim]
+    return np.asarray(leaf)
+r = fwd(params, tokens); _sync(r)
+f0 = time.perf_counter(); _sync(r)
+fence_s = time.perf_counter() - f0
 t0 = time.perf_counter()
 reps = 3
 for _ in range(reps):
-    fwd(params, tokens).block_until_ready()
-dt = (time.perf_counter() - t0) / reps
+    r = fwd(params, tokens)
+_sync(r)
+dt = max(time.perf_counter() - t0 - fence_s, 1e-9) / reps
 tok_s = seq / dt
 out["llama3_1b_fwd_tokens_per_s"] = round(tok_s, 1)
 out["llama3_1b_params"] = n_params

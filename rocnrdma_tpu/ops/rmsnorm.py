@@ -13,6 +13,7 @@ grid in VMEM scratch, so x and g are each read from HBM exactly once.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +27,19 @@ from rocnrdma_tpu.ops.common import trace_time_knob
 _BLOCK_ROWS = 256
 
 
+def _block_rows(override=None) -> int:
+    """Row-block size for both kernels. Resolved at TRACE time:
+    explicit argument > ``TDR_RMSNORM_BLOCK`` env > 256. The knob
+    exists so the on-chip tune sweep (tools/tpu_extra.py) can size the
+    VMEM working set without a code edit."""
+    val = int(override if override is not None
+              else os.environ.get("TDR_RMSNORM_BLOCK", _BLOCK_ROWS))
+    if val <= 0:
+        raise ValueError(
+            f"rmsnorm block_rows/TDR_RMSNORM_BLOCK={val}: must be positive")
+    return val
+
+
 def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
     x = x_ref[:].astype(jnp.float32)
     ms = jnp.mean(x * x, axis=-1, keepdims=True)
@@ -33,9 +47,10 @@ def _rmsnorm_kernel(x_ref, w_ref, o_ref, *, eps: float):
     o_ref[:] = (y * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def _rmsnorm_fwd_pallas(x2d, w, eps: float, interpret: bool):
+def _rmsnorm_fwd_pallas(x2d, w, eps: float, interpret: bool,
+                        block_rows: int = None):
     rows, d = x2d.shape
-    block = min(_BLOCK_ROWS, rows)
+    block = min(_block_rows(block_rows), rows)
     grid = (pl.cdiv(rows, block),)
     return pl.pallas_call(
         functools.partial(_rmsnorm_kernel, eps=eps),
@@ -60,18 +75,19 @@ def rmsnorm_reference(x, w, eps: float = 1e-5):
         x.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
-def _rmsnorm_cvjp(x, w, eps: float, use_pallas: bool, interpret: bool):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _rmsnorm_cvjp(x, w, eps: float, use_pallas: bool, interpret: bool,
+                  block_rows: int = None):
     if not use_pallas:
         return rmsnorm_reference(x, w, eps)
     shape = x.shape
     x2d = x.reshape(-1, shape[-1])
-    out = _rmsnorm_fwd_pallas(x2d, w, eps, interpret)
+    out = _rmsnorm_fwd_pallas(x2d, w, eps, interpret, block_rows)
     return out.reshape(shape)
 
 
 def rmsnorm(x, w, eps: float = 1e-5, use_pallas: bool = True,
-            interpret: bool = False):
+            interpret: bool = False, *, block_rows: int = None):
     """RMSNorm over the last axis. ``use_pallas`` selects the fused
     kernels for BOTH passes — the backward is a single Pallas kernel
     producing row-local dx and accumulating dw across row blocks in
@@ -83,10 +99,10 @@ def rmsnorm(x, w, eps: float = 1e-5, use_pallas: bool = True,
     divide fall back to the XLA reference — a bare pallas_call must
     never reach GSPMD's partitioner."""
     if not use_pallas:
-        return _rmsnorm_cvjp(x, w, eps, use_pallas, interpret)
+        return _rmsnorm_cvjp(x, w, eps, use_pallas, interpret, block_rows)
 
     def local(x_, w_):
-        return _rmsnorm_cvjp(x_, w_, eps, True, interpret)
+        return _rmsnorm_cvjp(x_, w_, eps, True, interpret, block_rows)
 
     def fits(mesh, ba, _ha):
         return (ba in mesh.shape and x.ndim >= 2
@@ -145,9 +161,10 @@ def _rmsnorm_bwd_kernel(x_ref, w_ref, g_ref, dx_ref, dw_ref, dw_acc, *,
         dw_ref[:] = dw_acc[:].astype(dw_ref.dtype)
 
 
-def _rmsnorm_bwd_pallas(x2d, w, g2d, eps: float, interpret: bool):
+def _rmsnorm_bwd_pallas(x2d, w, g2d, eps: float, interpret: bool,
+                        block_rows: int = None):
     rows, d = x2d.shape
-    block = min(_BLOCK_ROWS, rows)
+    block = min(_block_rows(block_rows), rows)
     # The row-block walk must be sequential: dw accumulates across it.
     grid = (pl.cdiv(rows, block),)
     dx, dw = pl.pallas_call(
@@ -177,17 +194,18 @@ def _rmsnorm_bwd_pallas(x2d, w, g2d, eps: float, interpret: bool):
     return dx, dw[0]
 
 
-def _rmsnorm_fwd(x, w, eps, use_pallas, interpret):
-    return _rmsnorm_cvjp(x, w, eps, use_pallas, interpret), (x, w)
+def _rmsnorm_fwd(x, w, eps, use_pallas, interpret, block_rows=None):
+    return _rmsnorm_cvjp(x, w, eps, use_pallas, interpret, block_rows), (x, w)
 
 
-def _rmsnorm_bwd(eps, use_pallas, interpret, res, g):
+def _rmsnorm_bwd(eps, use_pallas, interpret, block_rows, res, g):
     x, w = res
     knob = trace_time_knob("TDR_RMSNORM_BWD", ("pallas", "xla"), "pallas")
     d = x.shape[-1]
     if use_pallas and knob == "pallas":
         dx2d, dw = _rmsnorm_bwd_pallas(
-            x.reshape(-1, d), w, g.reshape(-1, d), eps, interpret)
+            x.reshape(-1, d), w, g.reshape(-1, d), eps, interpret,
+            block_rows)
         return dx2d.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype)
     dx, gxhat = _bwd_math(x.astype(jnp.float32), g.astype(jnp.float32),
                           w.astype(jnp.float32), eps)

@@ -291,6 +291,41 @@ def test_jax_shim_pytree_sum_and_mean():
         w.close()
 
 
+def test_staged_pipeline_opt_in_parity(monkeypatch):
+    """The staged pipeline is opt-in since r05 (it measured 0.41x of
+    serial through the device tunnel, TPU_RESULTS_r05_staged.json).
+    Forcing it on with a tiny segment size must still produce exact
+    rank sums and account the same staged bytes as the serial path."""
+    from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+
+    monkeypatch.setenv("TDR_STAGE_PIPELINE", "1")
+    # _stage_chunk floors at 4096 bytes — leaves must each exceed it
+    # so the segment plan really has >1 segment and the pipelined
+    # branch (executor + double-buffer deque) actually executes.
+    monkeypatch.setenv("TDR_STAGE_CHUNK", "4096")
+    worlds = local_worlds(2, free_port() + 300)
+    staging.reset()
+    leaves = [np.arange(2048, dtype=np.float32) * (r + 1) for r in range(2)]
+    outs = [None, None]
+    shims = [None, None]
+
+    def go(w, r):
+        ar = shims[r] = CrossSliceAllReduce(w, mean=False)
+        outs[r] = ar([leaves[r], leaves[r] * 2, leaves[r] + 1])
+
+    run_ranks(worlds, go)
+    base = np.arange(2048, dtype=np.float32)
+    for r in range(2):
+        np.testing.assert_allclose(outs[r][0], base * 3)
+        np.testing.assert_allclose(outs[r][1], base * 6)
+        np.testing.assert_allclose(outs[r][2], base * 3 + 2)
+        # The lazily-created worker proves the pipelined branch ran.
+        assert shims[r]._stage_ex is not None
+    assert staging.bytes > 0
+    for w in worlds:
+        w.close()
+
+
 def test_expect_zero_staging_guard():
     staging.reset()
     with staging.expect_zero():

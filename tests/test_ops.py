@@ -20,6 +20,37 @@ def test_rmsnorm_matches_reference():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_rmsnorm_block_rows_knob(monkeypatch):
+    """block_rows (arg or TDR_RMSNORM_BLOCK env) changes the grid, not
+    the math: a block that does NOT divide the row count exercises the
+    masked-tail path in both passes."""
+    x = jax.random.normal(jax.random.PRNGKey(2), (10, 64))
+    w = jnp.ones((64,)) * 0.5
+    want = rmsnorm_reference(x, w)
+    gx_r, gw_r = jax.grad(
+        lambda x, w: jnp.sum(rmsnorm_reference(x, w) ** 2),
+        argnums=(0, 1))(x, w)
+    for br in (4, 7, 16):
+        got = rmsnorm(x, w, use_pallas=True, interpret=True, block_rows=br)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        gx_p, gw_p = jax.grad(
+            lambda x, w, br=br: jnp.sum(rmsnorm(
+                x, w, use_pallas=True, interpret=True,
+                block_rows=br) ** 2), argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx_p), np.asarray(gx_r),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gw_p), np.asarray(gw_r),
+                                   rtol=1e-4, atol=1e-4)
+    monkeypatch.setenv("TDR_RMSNORM_BLOCK", "7")
+    got = rmsnorm(x, w, use_pallas=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    monkeypatch.setenv("TDR_RMSNORM_BLOCK", "0")
+    with pytest.raises(ValueError, match="TDR_RMSNORM_BLOCK"):
+        rmsnorm(x, w, use_pallas=True, interpret=True)
+
+
 def test_rmsnorm_grad():
     x = jax.random.normal(jax.random.PRNGKey(0), (8, 64))
     w = jnp.ones((64,)) * 1.5

@@ -66,8 +66,15 @@ def test_annotate_missing_marks_incomplete_banks():
     te.annotate_missing(bank)
     assert bank["missing_sections"] == ["longseq", "train"]
 
+    # train needs BOTH A/B sides: a bank holding only the pallas half
+    # (e.g. the xla run was fence-broken and discarded) stays
+    # incomplete so a later window re-measures the discarded half.
     bank.update({"llama3_1b_train_mfu_pallas": 0.4,
                  "long_seq_attention": {}})
+    te.annotate_missing(bank)
+    assert bank["missing_sections"] == ["train"]
+
+    bank["llama3_1b_train_mfu_xla"] = 0.37
     te.annotate_missing(bank)
     assert "missing_sections" not in bank  # and stale markers clear
 

@@ -84,13 +84,20 @@ def main():
         for mode, env in (("serial", "1"), ("overlap", "0")):
             os.environ["TDR_RA_NO_OVERLAP"] = env
 
+            def _sync(t):
+                # block_until_ready is not a trustworthy fence on this
+                # tunnel (see tools/tpu_extra.py); materializing one
+                # element forces real completion at 4-byte D2H cost.
+                leaf = jax.tree_util.tree_leaves(t)[0]
+                np.asarray(leaf[(0,) * leaf.ndim])
+
             def fwd_bwd(r):
                 o, lse = ras[r].forward(qs[r], ks[r], vs[r], causal=True)
-                jax.block_until_ready(o)
+                _sync(o)
                 fw, ft = ras[r].last_wait_s, ras[r].last_total_s
                 g = ras[r].backward(qs[r], ks[r], vs[r], o, lse, dos[r],
                                     causal=True)
-                jax.block_until_ready(g)
+                _sync(g)
                 return (fw, ft, ras[r].last_wait_s, ras[r].last_total_s)
 
             run_ranks(W, fwd_bwd)  # warm: compiles + registers buffers
@@ -131,6 +138,10 @@ def main():
 if __name__ == "__main__":
     try:
         sys.exit(main())
+    except SystemExit:
+        # sys.exit(main()) lands here on every return path; main()
+        # already logged its own failures, so never double-log.
+        raise
     except BaseException as e:  # noqa: BLE001 — every run must log
         log_attempt(TOOL, {"ok": False,
                            "error": f"{type(e).__name__}: {e}"[:400]})

@@ -15,9 +15,10 @@ gather of segment i+1.
 Method: two in-process ranks (the same shape ``bench.py:bench_staged``
 uses), each syncing a tree of TPU-device-resident float32 leaves
 through ``CrossSliceAllReduce``; leaves have no dma-buf exporter so
-they take the staged gather→ring→scatter path. TDR_NO_STAGE_PIPELINE
-toggles the pipeline per pass (read per call). One correctness sync
-first (every leaf must come back rank-summed), then timed passes.
+they take the staged gather→ring→scatter path. TDR_STAGE_PIPELINE
+toggles the (opt-in since r05) pipeline per pass (read per call). One
+correctness sync first (every leaf must come back rank-summed), then
+timed passes.
 
 Writes TPU_RESULTS_<round>_staged.json and appends to the round's
 attempt log, same discipline as tools/tpu_chase.py.
@@ -92,8 +93,8 @@ def main():
         out["correctness"] = "rank-summed (1+2=3) verified on device leaves"
 
         staged0 = staging.bytes
-        for mode, env in (("serial", "1"), ("pipelined", "0")):
-            os.environ["TDR_NO_STAGE_PIPELINE"] = env
+        for mode, pipe in (("serial", "0"), ("pipelined", "1")):
+            os.environ["TDR_STAGE_PIPELINE"] = pipe
             trees = make_trees()
             sync_all(trees)  # warm (registers staging buffers, compiles)
             t0 = time.perf_counter()
@@ -107,7 +108,7 @@ def main():
         out["pipeline_speedup"] = round(
             out["staged_tpu_serial_s"] / out["staged_tpu_pipelined_s"], 3)
     finally:
-        os.environ.pop("TDR_NO_STAGE_PIPELINE", None)
+        os.environ.pop("TDR_STAGE_PIPELINE", None)
         for sh in shims:
             sh.close()
         for w in worlds:
@@ -123,6 +124,10 @@ def main():
 if __name__ == "__main__":
     try:
         sys.exit(main())
+    except SystemExit:
+        # sys.exit(main()) lands here on every return path; main()
+        # already logged its own failures, so never double-log.
+        raise
     except BaseException as e:  # noqa: BLE001 — every run must log
         log_attempt(TOOL, {"ok": False,
                            "error": f"{type(e).__name__}: {e}"[:400]})

@@ -67,15 +67,27 @@ for mb in (16, 64):
     out[f"tpu_d2h_GBps_{mb}MB"] = round(n * 4 / (time.perf_counter() - t0) / 1e9, 3)
     print(f"STEP h2d_{mb}", flush=True)
 
+# block_until_ready is NOT a trustworthy fence on this tunnel (the
+# 04:08Z 2026-07-31 window banked 57x-over-peak "timings" through
+# it); materializing one element is. Chained device-side loops keep
+# per-dispatch tunnel latency out of the per-op time.
+def _sync(r):
+    leaf = jax.tree_util.tree_leaves(r)[0]
+    if getattr(leaf, "ndim", 0):
+        leaf = leaf[(0,) * leaf.ndim]
+    return np.asarray(leaf)
+
 for k in (4096, 8192):
     a = jnp.ones((k, k), jnp.bfloat16); b = jnp.ones((k, k), jnp.bfloat16)
-    mm = jax.jit(lambda a, b: a @ b)
-    mm(a, b).block_until_ready()
-    t0 = time.perf_counter(); reps = 5
-    for _ in range(reps):
-        r = mm(a, b)
-    r.block_until_ready()
-    dt = (time.perf_counter() - t0) / reps
+    iters = 10
+    mm = jax.jit(lambda a_: jax.lax.fori_loop(
+        0, iters, lambda i, y: y @ b, a_))
+    r = mm(a); _sync(r)
+    f0 = time.perf_counter(); _sync(r)
+    fence_s = time.perf_counter() - f0
+    t0 = time.perf_counter()
+    _sync(mm(a))
+    dt = max(time.perf_counter() - t0 - fence_s, 1e-9) / iters
     out[f"matmul_bf16_{k}_TFLOPs"] = round(2 * k**3 / dt / 1e12, 2)
     print(f"STEP matmul_{k}", flush=True)
 
@@ -90,13 +102,15 @@ params = jax.device_put(params, dev)
 seq = 2048
 tokens = jnp.ones((1, seq), dtype=jnp.int32)
 fwd = jax.jit(lambda p, t: model.apply(p, t))
-fwd(params, tokens).block_until_ready()
+r = fwd(params, tokens); _sync(r)
+f0 = time.perf_counter(); _sync(r)
+fence_s = time.perf_counter() - f0
 t0 = time.perf_counter()
-reps = 3
+reps = 5
 for _ in range(reps):
     r = fwd(params, tokens)
-r.block_until_ready()
-dt = (time.perf_counter() - t0) / reps
+_sync(r)
+dt = max(time.perf_counter() - t0 - fence_s, 1e-9) / reps
 n_params = model.cfg.param_count()
 out["llama3_1b_fwd_tokens_per_s"] = round(seq / dt, 1)
 out["llama3_1b_params"] = n_params
@@ -110,12 +124,14 @@ try:
     from rocnrdma_tpu.models.llama import make_model as mk
     mp = mk("llama3-1b", use_pallas_attention=True, use_pallas_rmsnorm=True)
     fwd_p = jax.jit(lambda p, t: mp.apply(p, t))
-    fwd_p(params, tokens).block_until_ready()
+    r = fwd_p(params, tokens); _sync(r)
+    f0 = time.perf_counter(); _sync(r)
+    fence_s = time.perf_counter() - f0
     t0 = time.perf_counter()
     for _ in range(reps):
         r = fwd_p(params, tokens)
-    r.block_until_ready()
-    dtp = (time.perf_counter() - t0) / reps
+    _sync(r)
+    dtp = max(time.perf_counter() - t0 - fence_s, 1e-9) / reps
     out["llama3_1b_fwd_tokens_per_s_pallas"] = round(seq / dtp, 1)
 except Exception as e:
     out["pallas_fwd"] = f"failed: {type(e).__name__}: {e}"

@@ -1,16 +1,57 @@
 #!/bin/sh
 # Round-long TPU chase driver: loop the core bench until the tunnel
 # answers (tpu_chase banks TPU_RESULTS_r05.json and exits 0), then run
-# the deep kernel measurements (tpu_extra). If the tunnel dies between
-# the two, go back to chasing. Every attempt is logged to
-# TPU_ATTEMPTS_r05.jsonl either way.
+# the rest of the measurement queue in priority order:
+#   1. tpu_extra on exactly the sections the merged bank still lists
+#      as missing (merge_bank keeps previously banked keys)
+#   2. staged_tpu_demo  (pipelined-vs-serial staged allreduce on chip)
+#   3. ring_attention_tpu_demo  (overlap hidden-fraction on chip)
+#   4. tpu_extra tune section (block-size sweep) — lowest priority
+# Every stage is guarded by "is its artifact already banked?" so a
+# mid-queue tunnel death never re-burns a later window re-measuring
+# banked data. Attempts land in TPU_ATTEMPTS_r05.jsonl either way.
 cd "$(dirname "$0")/.." || exit 1
+ROUND="${TDR_ROUND:-r05}"
+
+missing_sections() {
+  python -c "
+import json, sys
+try:
+    d = json.load(open('TPU_RESULTS_${ROUND}_extra.json'))
+except Exception:
+    print('entry,ops,train,longseq,decode'); sys.exit(0)
+print(','.join(d.get('missing_sections', [])))"
+}
+
+# After a mid-queue failure, verify the tunnel actually answers (one
+# cheap chase probe, which also refreshes the core bank) before
+# re-burning a long stage timeout against a dead tunnel.
+rechase() {
+  echo "tpu_session: $1 failed; probing the tunnel before retrying"
+  until python tools/tpu_chase.py --once; do sleep 240; done
+}
+
 while true; do
-  python tools/tpu_chase.py || exit 1   # loops internally until banked
-  if python tools/tpu_extra.py; then
-    echo "tpu_session: both banked, done"
-    exit 0
+  if [ ! -f "TPU_RESULTS_${ROUND}.json" ]; then
+    python tools/tpu_chase.py || exit 1   # loops until banked
   fi
-  echo "tpu_session: extra failed after chase success; re-chasing in 300s"
-  sleep 300
+  SECT="$(missing_sections)"
+  if [ -n "$SECT" ]; then
+    TDR_EXTRA_SECTIONS="$SECT" python tools/tpu_extra.py || {
+      rechase "extra($SECT)"; continue; }
+  fi
+  if [ ! -f "TPU_RESULTS_${ROUND}_staged.json" ]; then
+    python tools/staged_tpu_demo.py || { rechase "staged demo"; continue; }
+  fi
+  if [ ! -f "TPU_RESULTS_${ROUND}_ringattn.json" ]; then
+    python tools/ring_attention_tpu_demo.py || {
+      rechase "ringattn demo"; continue; }
+  fi
+  if ! grep -q attn_block_tuning "TPU_RESULTS_${ROUND}_extra.json" 2>/dev/null \
+     || ! grep -q rmsnorm_block_tuning "TPU_RESULTS_${ROUND}_extra.json" 2>/dev/null; then
+    TDR_EXTRA_SECTIONS=tune python tools/tpu_extra.py || {
+      rechase "tune"; continue; }
+  fi
+  echo "tpu_session: full queue banked, done"
+  exit 0
 done
