@@ -194,24 +194,31 @@ def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
 
 
 def bench_sweep(timeout_s=300, max_size="1G"):
-    """Config-2: the 4 B–1 GiB message-size sweep (peak bandwidth and
-    small-message latency) via the perftest-analogue tool."""
-    port = _free_port()
-    try:
+    """Config-2: the 4 B–1 GiB message-size sweep (peak bandwidth with
+    the tool's pipelined tx-depth) plus small-message latency from a
+    SEPARATE --lat run — with writes in flight, the bw sweep's
+    ``lat_us`` is inverse throughput at queue depth, not a round
+    trip, so it must not feed the latency key."""
+    def run_cli(extra):
         proc = subprocess.run(
             [sys.executable, "-m", "rocnrdma_tpu.tools.perf", "--loopback",
-             "--engine", "emu", "--op", "write", "--sizes", f"4:{max_size}",
-             "--iters", "4", "--port", str(port), "--json"],
+             "--engine", "emu", "--op", "write",
+             "--port", str(_free_port()), "--json"] + extra,
             capture_output=True, text=True, timeout=timeout_s, cwd=REPO)
         for line in proc.stdout.splitlines():
             if line.startswith("{"):
-                out = json.loads(line)
-                return {
-                    "peak_GBps": out["peak_GBps"],
-                    "lat_4B_us": out["sweep"][0]["lat_us"],
-                    "sweep": out["sweep"],
-                }
-        return {"error": (proc.stderr or "no JSON line").strip()[-300:]}
+                return json.loads(line)
+        raise RuntimeError((proc.stderr or "no JSON line").strip()[-300:])
+
+    try:
+        out = run_cli(["--sizes", f"4:{max_size}", "--iters", "4"])
+        lat = run_cli(["--sizes", "4", "--iters", "32", "--lat"])
+        return {
+            "peak_GBps": out["peak_GBps"],
+            "lat_4B_us": lat["sweep"][0]["lat_us_p50"],
+            "lat_4B_p99_us": lat["sweep"][0]["lat_us_p99"],
+            "sweep": out["sweep"],
+        }
     except Exception as e:  # noqa: BLE001 — recorded, not swallowed
         return {"error": f"{type(e).__name__}: {e}"}
 

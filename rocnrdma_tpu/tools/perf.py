@@ -77,7 +77,8 @@ def _mr_for(engine, nbytes: int, hbm: str):
 
 
 def run_peer(engine, qp, sizes: List[int], op: str, iters: int,
-             is_client: bool, hbm: str, out=sys.stdout):
+             is_client: bool, hbm: str, out=sys.stdout, qd: int = 16,
+             lat: bool = False):
     from rocnrdma_tpu.transport import engine as eng
 
     max_size = max(sizes)
@@ -108,10 +109,51 @@ def run_peer(engine, qp, sizes: List[int], op: str, iters: int,
         for size in sizes:
             post(mr, 0, raddr, rkey, size, wr_id=0)  # warmup
             assert qp.wait(0, timeout_ms=120000).ok
+            if lat:
+                # ib_write_lat analogue: strictly serial post→completion
+                # round trips, distribution reported like perftest's
+                # t_min / t_typical / t_max (plus p99).
+                times = np.empty(iters)
+                for i in range(iters):
+                    t1 = time.perf_counter()
+                    post(mr, 0, raddr, rkey, size, wr_id=i + 1)
+                    assert qp.wait(i + 1, timeout_ms=120000).ok
+                    times[i] = time.perf_counter() - t1
+                times *= 1e6
+                rec = {"bytes": size,
+                       "lat_us_min": round(float(times.min()), 2),
+                       "lat_us_p50": round(float(np.percentile(times, 50)), 2),
+                       "lat_us_p99": round(float(np.percentile(times, 99)), 2),
+                       "lat_us_max": round(float(times.max()), 2)}
+                results.append(rec)
+                print(f"{size:>12}  min {rec['lat_us_min']:>9.2f}  "
+                      f"p50 {rec['lat_us_p50']:>9.2f}  "
+                      f"p99 {rec['lat_us_p99']:>9.2f}  "
+                      f"max {rec['lat_us_max']:>9.2f} us",
+                      file=out, flush=True)
+                continue
+            # ib_write_bw analogue: keep up to ``qd`` writes in flight
+            # (perftest's tx-depth); a serial post→wait loop measures
+            # latency, not bandwidth, for small messages.
+            depth = max(1, min(qd, iters))
+            inflight = set()
+            nexti = 0
+            completed = 0
             t0 = time.perf_counter()
-            for i in range(iters):
-                post(mr, 0, raddr, rkey, size, wr_id=i + 1)
-                assert qp.wait(i + 1, timeout_ms=120000).ok
+            while completed < iters:
+                while nexti < iters and len(inflight) < depth:
+                    post(mr, 0, raddr, rkey, size, wr_id=nexti + 1)
+                    inflight.add(nexti + 1)
+                    nexti += 1
+                wcs = qp.poll(16, timeout_ms=120000)
+                if not wcs:
+                    raise RuntimeError(
+                        "tdr_perf: completion timeout at "
+                        f"{completed}/{iters} (size {size})")
+                for c in wcs:
+                    assert c.ok, f"wr {c.wr_id} status {c.status}"
+                    inflight.discard(c.wr_id)
+                    completed += 1
             dt = time.perf_counter() - t0
             bw = size * iters / dt / 1e9
             lat_us = dt / iters * 1e6
@@ -149,6 +191,11 @@ def main(argv=None):
     ap.add_argument("--op", choices=["write", "read"], default="write")
     ap.add_argument("--sizes", default="4:1G")
     ap.add_argument("--iters", type=int, default=16)
+    ap.add_argument("--qd", type=int, default=16,
+                    help="outstanding writes in bw mode (perftest tx-depth)")
+    ap.add_argument("--lat", action="store_true",
+                    help="ib_write_lat mode: serial round trips, "
+                         "min/p50/p99/max percentiles")
     ap.add_argument("--engine", default=None,
                     help="emu | verbs[:dev] | auto (default: TDR_ENGINE)")
     ap.add_argument("--hbm", choices=["host", "fake"], default="host",
@@ -177,29 +224,33 @@ def main(argv=None):
         st = threading.Thread(
             target=run_peer,
             args=(e, srv_qp[0], sizes, args.op, args.iters, False,
-                  args.hbm))
+                  args.hbm),
+            kwargs={"qd": args.qd, "lat": args.lat})
         st.start()
         results = run_peer(e, cli, sizes, args.op, args.iters, True,
-                           args.hbm)
+                           args.hbm, qd=args.qd, lat=args.lat)
         st.join()
         srv_qp[0].close(); cli.close(); e.close()
     elif args.listen:
         e = Engine(spec)
         qp = e.listen(args.bind, args.port)
         results = run_peer(e, qp, sizes, args.op, args.iters, False,
-                           args.hbm)
+                           args.hbm, qd=args.qd, lat=args.lat)
         qp.close(); e.close()
     else:
         e = Engine(spec)
         qp = e.connect(args.host, args.port, timeout_ms=60000)
         results = run_peer(e, qp, sizes, args.op, args.iters, True,
-                           args.hbm)
+                           args.hbm, qd=args.qd, lat=args.lat)
         qp.close(); e.close()
 
     if args.json and results:
-        peak = max(r["GBps"] for r in results)
-        print(json.dumps({"op": args.op, "peak_GBps": peak,
-                          "sweep": results}))
+        summary = {"op": args.op, "sweep": results}
+        if args.lat:
+            summary["min_lat_us"] = min(r["lat_us_min"] for r in results)
+        else:
+            summary["peak_GBps"] = max(r["GBps"] for r in results)
+        print(json.dumps(summary))
     return 0
 
 

@@ -64,6 +64,12 @@ def test_annotate_missing_marks_incomplete_banks():
             "attn_h16kv8s2048d128_us": {"pallas": 7000},
             "llama3_1b_decode": {"tokens_per_s_64new": 400}}
     te.annotate_missing(bank)
+    # ops needs BOTH op timings (the 04:16Z window banked attention
+    # but a meaningless 0.0-us rmsnorm).
+    assert bank["missing_sections"] == ["longseq", "ops", "train"]
+
+    bank["rmsnorm_b8s2048d2048_us"] = {"pallas": 17, "xla": 20}
+    te.annotate_missing(bank)
     assert bank["missing_sections"] == ["longseq", "train"]
 
     # train needs BOTH A/B sides: a bank holding only the pallas half
@@ -111,6 +117,38 @@ def test_collective_cli_runs_every_op():
         assert rc == 0
         out = json.loads(buf.getvalue().strip().splitlines()[-1])
         assert out["op"] == op and out["bus_GBps"] > 0
+
+
+def test_perf_cli_lat_and_qd_modes():
+    """tdr_perf covers both perftest roles: --lat (ib_write_lat:
+    serial round trips with a min/p50/p99/max distribution) and the
+    default bw mode with --qd outstanding writes (ib_write_bw's
+    tx-depth)."""
+    from test_transport import free_port
+
+    from rocnrdma_tpu.tools import perf as cli
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main(["--loopback", "--op", "write", "--sizes", "4K",
+                       "--iters", "24", "--lat", "--json",
+                       "--port", str(free_port())])
+    assert rc == 0
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    rec = out["sweep"][0]
+    assert (rec["lat_us_min"] <= rec["lat_us_p50"]
+            <= rec["lat_us_p99"] <= rec["lat_us_max"])
+    assert out["min_lat_us"] == rec["lat_us_min"]
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main(["--loopback", "--op", "write", "--sizes", "64K",
+                       "--iters", "24", "--qd", "8", "--json",
+                       "--port", str(free_port())])
+    assert rc == 0
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["peak_GBps"] > 0
+    assert out["sweep"][0]["lat_us"] > 0
 
 
 def test_bench_snippet_compiles_and_is_section_complete():

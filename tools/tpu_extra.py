@@ -105,14 +105,28 @@ def timeit_dev(fn, x0, iters=50):
     # through a fori_loop -- ONE dispatch, ONE forced fence, so neither
     # per-call dispatch latency nor the broken host fence can pollute
     # the per-iteration time. fn's output must match x0's shape/dtype.
-    lfn = jax.jit(lambda x: jax.lax.fori_loop(0, iters, lambda i, y: fn(y), x))
-    r = lfn(x0); _sync(r)
-    f0 = time.perf_counter(); _sync(r)
-    fence_s = time.perf_counter() - f0
-    t0 = time.perf_counter()
-    r = lfn(x0)
-    _sync(r)
-    return max(time.perf_counter() - t0 - fence_s, 1e-9) / iters, r
+    def run(n):
+        lfn = jax.jit(lambda x: jax.lax.fori_loop(
+            0, n, lambda i, y: fn(y), x))
+        r = lfn(x0); _sync(r)
+        f0 = time.perf_counter(); _sync(r)
+        fence = time.perf_counter() - f0
+        t0 = time.perf_counter()
+        r = lfn(x0)
+        _sync(r)
+        return time.perf_counter() - t0, fence, r
+    # The 04:16Z window banked rmsnorm as "0.0 us": a loop shorter
+    # than the (jittery) fence makes the subtraction meaningless.
+    # Escalate iters until the loop dwarfs the fence. ``n`` must always
+    # equal the iteration count of the run that produced ``el``.
+    n = iters
+    for attempt in range(3):
+        el, fence_s, r = run(n)
+        if el - fence_s >= 4 * fence_s:
+            break
+        if attempt < 2:
+            n *= 10
+    return max(el - fence_s, 1e-9) / n, r
 
 def _live(gs):
     # Chain gs[0] while keeping EVERY other gradient output data-live:
@@ -374,7 +388,10 @@ print("TPUBENCH " + json.dumps(out), flush=True)
 # Section → the bank key whose presence proves that section completed
 # at least once (used for the merged bank's completeness annotation).
 SECTION_KEYS = {"entry": ("entry_auto_pallas_compiles",),
-                "ops": ("attn_h16kv8s2048d128_us",),
+                # ops needs both op timings: the 04:16Z window banked
+                # attention but a meaningless 0.0-us rmsnorm.
+                "ops": ("attn_h16kv8s2048d128_us",
+                        "rmsnorm_b8s2048d2048_us"),
                 # train needs BOTH sides of the A/B: a fence-broken
                 # xla run with a clean pallas run (or vice versa) must
                 # leave the section incomplete so a later window

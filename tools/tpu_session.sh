@@ -39,6 +39,13 @@ while true; do
   if [ -n "$SECT" ]; then
     TDR_EXTRA_SECTIONS="$SECT" python tools/tpu_extra.py || {
       rechase "extra($SECT)"; continue; }
+    # A clean run can still leave sections missing (e.g. a train
+    # measurement discarded by the fence-broken guard): keep looping
+    # until the bank is actually whole, never exit with gaps.
+    SECT2="$(missing_sections)"
+    if [ -n "$SECT2" ]; then
+      rechase "extra left missing ($SECT2)"; continue
+    fi
   fi
   if [ ! -f "TPU_RESULTS_${ROUND}_staged.json" ]; then
     python tools/staged_tpu_demo.py || { rechase "staged demo"; continue; }
