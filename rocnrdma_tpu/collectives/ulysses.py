@@ -1,0 +1,182 @@
+"""Ulysses-style sequence parallelism — all-to-all head↔sequence
+resharding over the transport.
+
+The second of the two first-class long-context strategies (the other
+is :class:`~rocnrdma_tpu.collectives.ring_attention.RingAttention`):
+instead of rotating K/V shards around the ring while queries stay
+put, BOTH operands reshard once — an all-to-all converts the
+sequence-sharded layout (every rank: all heads, S_local contiguous
+positions) into a head-sharded one (every rank: H/W heads, the FULL
+sequence), local flash attention runs unmodified on the full
+sequence for its head subset, and a second all-to-all converts the
+output back. Two collectives per call versus the ring's W-1
+rotations; the trade is wire volume (each all-to-all moves
+(W-1)/W of the tensor once) against the ring's overlap-friendly
+step structure.
+
+Transport role (SURVEY §5 L5 consumer): the resharding rides
+``RingWorld.all_to_all`` — the bundle-shrink ring schedule in
+``native/src/ring_allreduce.cc`` (``tdr_ring_alltoall``) — with
+front-loaded buffer registration (one registered staging buffer per
+distinct tensor geometry, steady state posts work requests only),
+and every host bounce charged to ``collectives.staging`` exactly
+like the ring-attention rotation.
+
+Layout contract (same as RingAttention): rank r holds the r-th
+contiguous sequence block; global position of local index i is
+``r * S_local + i``. Causality is exact because the head→sequence
+unpack reassembles blocks in rank order.
+
+Requires ``H % world == 0`` and ``KVH % world == 0`` (heads are the
+scattered axis); any ``S_local`` works.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+import jax
+
+from rocnrdma_tpu.collectives.staging import staging
+from rocnrdma_tpu.collectives.world import RingWorld
+from rocnrdma_tpu.ops.attention import flash_attention
+from rocnrdma_tpu.utils.trace import trace
+
+
+class UlyssesAttention:
+    """All-to-all sequence-parallel attention over a :class:`RingWorld`.
+
+    ``forward(q, k, v)`` takes this rank's sequence shard with FULL
+    heads — q ``(B, H, S_local, D)``, k/v ``(B, KVH, S_local, D)`` —
+    and returns ``out (B, H, S_local, D)``. All ranks must call
+    collectively. ``backward`` recomputes the head-sharded forward
+    (rematerialization — the full-sequence activations are never
+    stored across the call) and reshards the gradients home.
+    """
+
+    def __init__(self, world: RingWorld, interpret: bool = False):
+        self.world = world
+        self.interpret = interpret
+        # nbytes -> registered uint8 staging buffer. Keyed by SIZE, not
+        # geometry: same-size tensors share one buffer, which is safe
+        # only because each collective call fully consumes the buffer
+        # before the next begins (calls are serial per instance).
+        self._bufs = {}
+
+    # ------------------------------------------------------- resharding
+
+    def _staging(self, nbytes: int):
+        """Registered uint8 staging buffer (byte semantics: the
+        exchange reduces nothing, so any element dtype — bf16
+        included — rides as raw bytes)."""
+        buf = self._bufs.get(nbytes)
+        if buf is None:
+            buf = np.empty(nbytes, dtype=np.uint8)
+            self.world.ring.register_buffer(buf)
+            self._bufs[nbytes] = buf
+        return buf
+
+    def _check(self, h: int, what: str) -> int:
+        w = self.world.world
+        if h % w != 0:
+            raise ValueError(
+                f"ulysses: {what}={h} must divide by world={w}")
+        return h // w
+
+    def _seq_to_head(self, x):
+        """(B, h, S_local, D) sequence-sharded → (B, h/W, W*S_local, D)
+        head-sharded. Segment j of the all-to-all buffer carries head
+        block j of the local sequence shard; after the exchange it
+        holds this rank's head block of rank j's (= sequence block
+        j's) positions."""
+        w = self.world.world
+        b, h, s, d = x.shape
+        hw = self._check(h, "heads")
+        host = np.ascontiguousarray(np.asarray(x))  # D2H
+        buf = self._staging(host.nbytes)
+        segb = host.nbytes // w
+        for j in range(w):
+            buf[j * segb:(j + 1) * segb] = (
+                np.ascontiguousarray(host[:, j * hw:(j + 1) * hw])
+                .view(np.uint8).ravel())
+        staging.add(2 * host.nbytes)  # D2H above + H2D below
+        self.world.all_to_all(buf)
+        blocks = buf.view(host.dtype).reshape(w, b, hw, s, d)
+        full = np.concatenate([blocks[j] for j in range(w)], axis=2)
+        return jnp.asarray(full)
+
+    def _head_to_seq(self, y):
+        """(B, h/W, W*S_local, D) head-sharded → (B, h, S_local, D)
+        sequence-sharded — the exact inverse: segment j carries
+        sequence block j of the local head subset."""
+        w = self.world.world
+        b, hw, sg, d = y.shape
+        if sg % w != 0:
+            raise ValueError(
+                f"ulysses: global sequence {sg} must divide by world={w}")
+        s = sg // w
+        host = np.ascontiguousarray(np.asarray(y))  # D2H
+        buf = self._staging(host.nbytes)
+        segb = host.nbytes // w
+        for j in range(w):
+            buf[j * segb:(j + 1) * segb] = (
+                np.ascontiguousarray(host[:, :, j * s:(j + 1) * s])
+                .view(np.uint8).ravel())
+        staging.add(2 * host.nbytes)
+        self.world.all_to_all(buf)
+        blocks = buf.view(host.dtype).reshape(w, b, hw, s, d)
+        full = np.concatenate([blocks[j] for j in range(w)], axis=1)
+        return jnp.asarray(full)
+
+    # ------------------------------------------------------- attention
+
+    def _local(self, qf, kf, vf, causal: bool):
+        return flash_attention(qf, kf, vf, causal,
+                               interpret=self.interpret)
+
+    def forward(self, q, k, v, causal: bool = True):
+        """Sequence-parallel attention output for this rank's shard."""
+        q = jnp.asarray(q)
+        qf = self._seq_to_head(q)
+        kf = self._seq_to_head(jnp.asarray(k))
+        vf = self._seq_to_head(jnp.asarray(v))
+        out_full = self._local(qf, kf, vf, causal)
+        out = self._head_to_seq(out_full)
+        trace.event("ulysses.forward", rank=self.world.rank,
+                    world=self.world.world, heads_local=qf.shape[1],
+                    seq_global=qf.shape[2])
+        return out
+
+    def backward(self, q, k, v, dout, causal: bool = True):
+        """Exact (dq, dk, dv) for this rank's shard. The head-sharded
+        forward recomputes inside ``jax.vjp`` (rematerialization);
+        gradients reshard home through the same all-to-alls."""
+        qf = self._seq_to_head(jnp.asarray(q))
+        kf = self._seq_to_head(jnp.asarray(k))
+        vf = self._seq_to_head(jnp.asarray(v))
+        df = self._seq_to_head(jnp.asarray(dout))
+        _, pull = jax.vjp(
+            lambda q_, k_, v_: self._local(q_, k_, v_, causal),
+            qf, kf, vf)
+        dqf, dkf, dvf = pull(df)
+        dq = self._head_to_seq(dqf)
+        dk = self._head_to_seq(dkf)
+        dv = self._head_to_seq(dvf)
+        trace.event("ulysses.backward", rank=self.world.rank,
+                    world=self.world.world)
+        return dq, dk, dv
+
+    def close(self) -> None:
+        for buf in self._bufs.values():
+            try:
+                self.world.ring.unregister_buffer(buf)
+            except Exception:  # noqa: BLE001 — world may already be down
+                pass
+        self._bufs.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -99,6 +99,13 @@ class RingWorld:
         chunk pipeline down the ring)."""
         self.ring.broadcast(array, root)
 
+    def all_to_all(self, array) -> None:
+        """In-place all-to-all: the flat buffer is ``world`` equal
+        segments, segment j FOR rank j on entry, FROM rank j on
+        return (MPI_Alltoall; sequence<->head resharding's primitive,
+        collectives/ulysses.py)."""
+        self.ring.all_to_all(array)
+
     def reduce(self, array, root: int = 0, op: int = RED_SUM) -> None:
         """Root-reduce: root's buffer ends holding the reduction over
         all ranks; non-root buffers are clobbered with the partials

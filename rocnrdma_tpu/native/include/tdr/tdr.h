@@ -185,6 +185,8 @@ enum {
   TDR_DT_I32 = 2,
   TDR_DT_I64 = 3,
   TDR_DT_BF16 = 4, /* accumulated in f32 */
+  TDR_DT_U8 = 5,   /* byte transport (alltoall/all_gather/broadcast);
+                      reducing collectives reject it */
 };
 
 enum { TDR_RED_SUM = 0, TDR_RED_MAX = 1, TDR_RED_MIN = 2 };
@@ -208,6 +210,10 @@ int tdr_ring_reduce_scatter(tdr_ring *r, void *data, size_t count,
                             size_t *own_len);
 int tdr_ring_all_gather(tdr_ring *r, void *data, size_t count, int dtype);
 int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root);
+/* In-place MPI_Alltoall: ``data`` = world equal segments, segment j
+ * FOR rank j on entry, FROM rank j on return. count must divide by
+ * world. Bundle-shrink ring schedule, w(w-1)/2 segments per link. */
+int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype);
 /* Root-reduce: converging fold toward root (one N-byte pass per
  * link, chunk-pipelined through the fused recv_reduce op). In-place
  * and DESTRUCTIVE on non-root ranks: their buffers end holding the

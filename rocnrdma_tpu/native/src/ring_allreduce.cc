@@ -738,6 +738,10 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
     tdr::set_error("ring: bad dtype");
     return -1;
   }
+  if (dtype == TDR_DT_U8) {
+    tdr::set_error("ring_allreduce: u8 is byte-transport only (no fold semantics)");
+    return -1;
+  }
   if (count == 0) return 0;
   std::lock_guard<std::mutex> g(r->mu);
   const int world = r->world;
@@ -895,6 +899,10 @@ int tdr_ring_reduce_scatter(tdr_ring *r, void *data, size_t count,
     tdr::set_error("ring: bad dtype");
     return -1;
   }
+  if (dtype == TDR_DT_U8) {
+    tdr::set_error("ring_reduce_scatter: u8 is byte-transport only (no fold semantics)");
+    return -1;
+  }
   std::lock_guard<std::mutex> g(r->mu);
   const int world = r->world;
   std::vector<size_t> seg_off, seg_len;
@@ -956,6 +964,12 @@ struct ChainPump {
   size_t recv_win, send_win;
   bool head;  // no upstream: sends gate on nothing
   const char *label;
+  // Dependency slack: send i may post once done_r >= i+1-send_lead.
+  // 0 (chain collectives): forwarding send i needs recv i landed.
+  // 1 (alltoall): send 0 carries locally-built data and must go
+  // unconditionally or every rank deadlocks waiting for a first recv;
+  // send i>=1 forwards the tail of recv i-1.
+  size_t send_lead = 0;
 
   size_t posted_r = 0, done_r = 0, posted_s = 0, acked_s = 0;
 
@@ -996,7 +1010,7 @@ struct ChainPump {
         progressed = true;
       }
       while (posted_s < n_send && posted_s - acked_s < send_win &&
-             (head || posted_s < done_r)) {
+             (head || posted_s < done_r + send_lead)) {
         if (post_send(posted_s) != 0) return -1;
         posted_s++;
         progressed = true;
@@ -1035,6 +1049,10 @@ int tdr_ring_reduce(tdr_ring *r, void *data, size_t count, int dtype,
   size_t esz = dtype_size(dtype);
   if (esz == 0) {
     tdr::set_error("ring: bad dtype");
+    return -1;
+  }
+  if (dtype == TDR_DT_U8) {
+    tdr::set_error("ring_reduce: u8 is byte-transport only (no fold semantics)");
     return -1;
   }
   std::lock_guard<std::mutex> g(r->mu);
@@ -1138,6 +1156,105 @@ int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
         return tdr_post_send(r->right, dmr, i * chunk, clen(i),
                              kWrSend | i);
       });
+}
+
+/* In-place all-to-all (MPI_Alltoall with MPI_IN_PLACE semantics):
+ * ``data`` holds ``world`` equal segments; segment j is FOR rank j on
+ * entry and FROM rank j on return (this rank's own segment is
+ * untouched). Bundle-shrink ring schedule: rank r first sends the
+ * w-1 foreign segments ordered by destination distance
+ * [dst r+1, r+2, ...]; each received bundle's head is addressed to
+ * this rank (kept) and its tail IS the next step's send bundle,
+ * forwarded straight out of the receive slot — no re-pack copy. Per
+ * link w(w-1)/2 segments cross, the ring-topology optimum for
+ * store-and-forward all-to-all. */
+int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
+  if (!r || !data) {
+    tdr::set_error("ring_alltoall: null ring or data");
+    return -1;
+  }
+  size_t esz = dtype_size(dtype);
+  if (esz == 0) {
+    tdr::set_error("ring: bad dtype");
+    return -1;
+  }
+  std::lock_guard<std::mutex> g(r->mu);
+  const int world = r->world;
+  if (count % static_cast<size_t>(world) != 0) {
+    tdr::set_error("ring_alltoall: count must divide evenly by world "
+                   "(equal segments, MPI_Alltoall semantics)");
+    return -1;
+  }
+  if (count == 0 || world == 1) return 0;
+  const size_t segsz = count / world * esz;
+  const int rank = r->rank;
+  const size_t steps = static_cast<size_t>(world) - 1;
+  // No data MR: unlike the other collectives, the user buffer never
+  // touches the wire here — bundles stage through the scratch MR and
+  // the buffer is only memcpy'd, so registering it would be a pure
+  // per-call pin/unpin tax.
+
+  // Scratch: the outgoing first bundle (w-1 segments) + one receive
+  // slot per step, slot ri sized (w-1-ri) segments.
+  std::vector<size_t> slot_off(steps);
+  size_t total = steps * segsz;  // first-bundle staging at offset 0
+  for (size_t ri = 0; ri < steps; ri++) {
+    slot_off[ri] = total;
+    total += (steps - ri) * segsz;
+  }
+  tdr_mr *smr = r->scratch(total);
+  if (!smr) return -1;
+  char *sb = r->tmp.data();
+  char *db = static_cast<char *>(data);
+
+  // First bundle: foreign segments by destination distance.
+  for (size_t i = 0; i < steps; i++) {
+    int dst = static_cast<int>((rank + 1 + i) % world);
+    std::memcpy(sb + i * segsz, db + static_cast<size_t>(dst) * segsz,
+                segsz);
+  }
+
+  ChainPump pump{r,
+                 /*n_recv=*/steps,
+                 /*n_send=*/steps,
+                 /*recv_win=*/kMaxOutstanding,
+                 /*send_win=*/kMaxOutstanding,
+                 /*head=*/false,
+                 "ring(alltoall)"};
+  pump.send_lead = 1;  // send 0 is locally built; send i forwards recv i-1
+  int rc = pump.run(
+      [&](size_t ri) {
+        return tdr_post_recv(r->left, smr, slot_off[ri],
+                             (steps - ri) * segsz, kWrRecv | ri);
+      },
+      [&](size_t i) {
+        size_t off = i == 0 ? 0 : slot_off[i - 1] + segsz;
+        return tdr_post_send(r->right, smr, off, (steps - i) * segsz,
+                             kWrSend | i);
+      });
+  if (rc != 0) return rc;
+
+  // Keep every bundle head: recv step ri carried the segment from
+  // src (rank-1-ri) mod world addressed to this rank.
+  for (size_t ri = 0; ri < steps; ri++) {
+    int src = static_cast<int>(
+        ((rank - 1 - static_cast<int>(ri)) % world + world) % world);
+    std::memcpy(db + static_cast<size_t>(src) * segsz, sb + slot_off[ri],
+                segsz);
+  }
+  // The bundle scheme needs ~(w/2)x the buffer in scratch — far more
+  // than any other collective retains. Keep small scratch cached (the
+  // steady-state allreduce case) but release oversized growth rather
+  // than pinning it for the ring's lifetime.
+  if (total > (64u << 20)) {
+    if (r->tmp_mr) {
+      tdr_dereg_mr(r->tmp_mr);
+      r->tmp_mr = nullptr;
+    }
+    r->tmp.clear();
+    r->tmp.shrink_to_fit();
+  }
+  return 0;
 }
 
 }  // extern "C"

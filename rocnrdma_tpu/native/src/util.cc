@@ -53,6 +53,8 @@ size_t dtype_size(int dt) {
       return 8;
     case TDR_DT_BF16:
       return 2;
+    case TDR_DT_U8:
+      return 1;
     default:
       return 0;
   }

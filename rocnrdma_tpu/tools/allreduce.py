@@ -3,7 +3,7 @@
 The collective-level counterpart of ``tools.perf``: brings up an
 N-rank ring over the transport and measures collective bus bandwidth
 (default op: allreduce, the BASELINE.md config-3 metric;
---op also runs reduce_scatter / all_gather / broadcast / reduce,
+--op also runs alltoall / reduce_scatter / all_gather / broadcast / reduce,
 each with its own useful-bytes convention).
 
 Single machine, all ranks in one process (threads):
@@ -39,6 +39,7 @@ def run_rank(world_obj, count: int, dtype, iters: int, barrier=None,
         "all_gather": lambda: world_obj.all_gather(buf),
         "broadcast": lambda: world_obj.broadcast(buf, root=0),
         "reduce": lambda: world_obj.reduce(buf, root=0),
+        "alltoall": lambda: world_obj.all_to_all(buf),
     }[op]
     coll()  # warmup (+ peers' MR setup)
     if barrier is not None:
@@ -60,6 +61,10 @@ def bus_fraction(op: str, world: int) -> float:
         return float(world - 1) / world
     if op in ("broadcast", "reduce"):
         return 1.0  # the whole buffer crosses each link
+    if op == "alltoall":
+        # Bundle-shrink ring schedule: w(w-1)/2 segments of size
+        # buf/w cross each link -> (w-1)/2 of the buffer.
+        return (world - 1) / 2.0
     raise ValueError(f"no bus convention for op {op!r}")
 
 
@@ -77,7 +82,7 @@ def main(argv=None):
                              "bfloat16"])
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--op", default="allreduce",
-                    choices=["allreduce", "reduce_scatter", "all_gather",
+                    choices=["allreduce", "alltoall", "reduce_scatter", "all_gather",
                              "broadcast", "reduce"])
     ap.add_argument("--engine", default=None)
     ap.add_argument("--json", action="store_true")
@@ -100,6 +105,9 @@ def main(argv=None):
     count = max(1, sizes[0] // dtype.itemsize)
     spec = args.engine or get_config().engine
     world = args.world
+    if args.op == "alltoall":
+        # Equal-segment semantics: round down to a world multiple.
+        count = max(world, count - count % world)
 
     if args.rank is None:
         worlds = local_worlds(world, args.port, spec)

@@ -44,7 +44,7 @@ ACCESS_REMOTE_READ = 2
 OP_WRITE, OP_READ, OP_SEND, OP_RECV = 0, 1, 2, 3
 
 # Datatypes / reduce ops for the ring
-DT_F32, DT_F64, DT_I32, DT_I64, DT_BF16 = 0, 1, 2, 3, 4
+DT_F32, DT_F64, DT_I32, DT_I64, DT_BF16, DT_U8 = 0, 1, 2, 3, 4, 5
 RED_SUM, RED_MAX, RED_MIN = 0, 1, 2
 
 # Ring schedules (tdr_ring_last_schedule)
@@ -57,6 +57,9 @@ _NUMPY_DTYPE_MAP = {
     "int32": DT_I32,
     "int64": DT_I64,
     "bfloat16": DT_BF16,
+    # Byte transport only (alltoall / all_gather / broadcast); the
+    # reducing collectives reject it engine-side (no fold semantics).
+    "uint8": DT_U8,
 }
 
 
@@ -181,6 +184,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_reduce.restype = ctypes.c_int
     lib.tdr_ring_reduce.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.tdr_ring_alltoall.restype = ctypes.c_int
+    lib.tdr_ring_alltoall.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_int,
     ]
     lib.tdr_ring_destroy.argtypes = [P]
 
@@ -480,6 +487,18 @@ class Ring:
         rc = _load().tdr_ring_all_gather(
             _live(self._h, "ring_all_gather"), ptr, array.size, dt)
         _check(rc == 0, "ring_all_gather")
+
+    def all_to_all(self, array) -> None:
+        """In-place MPI_Alltoall: ``array.reshape(-1)`` is ``world``
+        equal segments — segment j is FOR rank j on entry and FROM
+        rank j on return (this rank's own segment is untouched).
+        ``array.size`` must divide evenly by the world size. Ring
+        bundle-shrink schedule: w(w-1)/2 segments cross each link,
+        the store-and-forward optimum for a ring topology."""
+        ptr, dt = self._array_args(array, "all_to_all")
+        rc = _load().tdr_ring_alltoall(
+            _live(self._h, "ring_alltoall"), ptr, array.size, dt)
+        _check(rc == 0, "ring_alltoall")
 
     def reduce(self, array, root: int, op: int = RED_SUM) -> None:
         """Root-reduce: after the call ROOT's buffer holds the

@@ -99,6 +99,41 @@ def test_reduce_scatter_then_all_gather_equals_allreduce(world_size,
 
 
 @pytest.mark.parametrize("world_size", [2, 3, 4])
+@pytest.mark.parametrize("dtype", [np.float32, np.int32])
+def test_all_to_all_is_the_segment_transpose(world_size, dtype):
+    """After all_to_all, rank r's segment j holds what rank j's
+    segment r held — the global segment matrix transposes. Own
+    segment (j == r) is untouched; per-element ramps catch any
+    offset arithmetic error; non-divisible counts are rejected on
+    every rank (fail-fast, before any wire traffic)."""
+    worlds = local_worlds(world_size, free_port() + 200)
+    seg = 1031  # prime: stresses offset math
+    def fill(r):
+        return np.concatenate(
+            [1000 * r + 10 * j + np.arange(seg) % 7
+             for j in range(world_size)]).astype(dtype)
+    bufs = [fill(r) for r in range(world_size)]
+
+    run_ranks(worlds, lambda w, r: w.all_to_all(bufs[r]))
+    for r in range(world_size):
+        want = np.concatenate(
+            [1000 * j + 10 * r + np.arange(seg) % 7
+             for j in range(world_size)]).astype(dtype)
+        np.testing.assert_array_equal(bufs[r], want)
+
+    # Second call on the same buffers transposes back to the start.
+    run_ranks(worlds, lambda w, r: w.all_to_all(bufs[r]))
+    for r in range(world_size):
+        np.testing.assert_array_equal(bufs[r], fill(r))
+
+    bad = np.zeros(world_size * seg + 1, dtype=dtype)
+    with pytest.raises(Exception, match="divide"):
+        worlds[0].all_to_all(bad)
+    for w in worlds:
+        w.close()
+
+
+@pytest.mark.parametrize("world_size", [2, 3, 4])
 def test_broadcast(world_size):
     """Every rank ends with root's bytes; non-root inputs are
     overwritten; non-trivial root exercises the forwarding chain."""

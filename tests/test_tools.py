@@ -107,7 +107,7 @@ def test_collective_cli_runs_every_op():
 
     from rocnrdma_tpu.tools import allreduce as cli
 
-    for op in ("allreduce", "reduce_scatter", "all_gather",
+    for op in ("allreduce", "alltoall", "reduce_scatter", "all_gather",
                "broadcast", "reduce"):
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
