@@ -16,11 +16,11 @@ step structure.
 
 Transport role (SURVEY §5 L5 consumer): the resharding rides
 ``RingWorld.all_to_all`` — the bundle-shrink ring schedule in
-``native/src/ring_allreduce.cc`` (``tdr_ring_alltoall``) — with
-front-loaded buffer registration (one registered staging buffer per
-distinct tensor geometry, steady state posts work requests only),
-and every host bounce charged to ``collectives.staging`` exactly
-like the ring-attention rotation.
+``native/src/ring_allreduce.cc`` (``tdr_ring_alltoall``), whose wire
+traffic stages through the ring's own registered scratch MR — with
+one reused staging buffer per distinct tensor size here, and every
+host bounce charged to ``collectives.staging`` exactly like the
+ring-attention rotation.
 
 Layout contract (same as RingAttention): rank r holds the r-th
 contiguous sequence block; global position of local index i is
@@ -58,7 +58,7 @@ class UlyssesAttention:
     def __init__(self, world: RingWorld, interpret: bool = False):
         self.world = world
         self.interpret = interpret
-        # nbytes -> registered uint8 staging buffer. Keyed by SIZE, not
+        # nbytes -> reused uint8 staging buffer. Keyed by SIZE, not
         # geometry: same-size tensors share one buffer, which is safe
         # only because each collective call fully consumes the buffer
         # before the next begins (calls are serial per instance).
@@ -67,13 +67,15 @@ class UlyssesAttention:
     # ------------------------------------------------------- resharding
 
     def _staging(self, nbytes: int):
-        """Registered uint8 staging buffer (byte semantics: the
-        exchange reduces nothing, so any element dtype — bf16
-        included — rides as raw bytes)."""
+        """Reused uint8 staging buffer (byte semantics: the exchange
+        reduces nothing, so any element dtype — bf16 included — rides
+        as raw bytes). Not ring-registered: tdr_ring_alltoall stages
+        all wire traffic through its own scratch MR and never consults
+        the ring's registered-buffer cache, so registration here would
+        pin an MR with zero effect on the wire path."""
         buf = self._bufs.get(nbytes)
         if buf is None:
             buf = np.empty(nbytes, dtype=np.uint8)
-            self.world.ring.register_buffer(buf)
             self._bufs[nbytes] = buf
         return buf
 
@@ -168,11 +170,6 @@ class UlyssesAttention:
         return dq, dk, dv
 
     def close(self) -> None:
-        for buf in self._bufs.values():
-            try:
-                self.world.ring.unregister_buffer(buf)
-            except Exception:  # noqa: BLE001 — world may already be down
-                pass
         self._bufs.clear()
 
     def __enter__(self):

@@ -27,6 +27,13 @@ L = (1/W)·Σ_r ℓ_r and each rank seeding its backward with dℓ_r/dout_r,
 the mean-allreduce of per-rank parameter grads is algebraically
 dL/dθ (each rank's local chains carry Σ_j ∂ℓ_j/∂θ|through-rank-r).
 
+Attention strategy (``sp_mode``): "ring" (default) rotates K/V
+through :class:`RingAttention`; "ulysses" reshards heads<->sequence
+through :class:`~rocnrdma_tpu.collectives.ulysses.UlyssesAttention`
+(two all-to-alls per layer-call instead of W-1 rotations; requires
+head counts divisible by the world). Both produce exact gradients —
+the training parity tests run the same contract over each.
+
 Replication contract: parameters and optimizer state are identical on
 every rank (same init seed, same averaged gradients, same update
 math), so ranks stay bit-synchronized without a parameter server.
@@ -74,10 +81,26 @@ class SeqParallelTrainer:
     def __init__(self, config: "LlamaConfig | str", world: RingWorld,
                  learning_rate: float = 3e-4, weight_decay: float = 0.1,
                  seed: int = 0, interpret: Optional[bool] = None,
-                 optimizer=None, **model_overrides):
+                 optimizer=None, sp_mode: str = "ring",
+                 **model_overrides):
+        if sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_mode={sp_mode!r}: must be 'ring' or 'ulysses'")
+        self.sp_mode = sp_mode
         self.model = make_model(config, **model_overrides)
         self.cfg = cfg = self.model.cfg
         self.world = world
+        if sp_mode == "ulysses":
+            # Ulysses scatters the HEAD axis; both head counts must
+            # divide the world (checked here so every rank fails fast
+            # at construction, not mid-ring).
+            for what, n in (("n_heads", cfg.n_heads),
+                            ("n_kv_heads", cfg.n_kv_heads)):
+                if n % world.world != 0:
+                    raise ValueError(
+                        f"sp_mode='ulysses': {what}={n} must divide by "
+                        f"world={world.world} (use sp_mode='ring' for "
+                        "head counts the world does not divide)")
         # cfg.remat (the production setting for sizes that matter):
         # wrap the jitted block halves in jax.checkpoint, so each
         # layer's vjp residual shrinks to the half's INPUTS — the
@@ -87,7 +110,11 @@ class SeqParallelTrainer:
         self._remat = bool(cfg.remat)
         if interpret is None:
             interpret = cfg.pallas_interpret
-        self.ring_attention = RingAttention(world, interpret=interpret)
+        if sp_mode == "ulysses":
+            from rocnrdma_tpu.collectives.ulysses import UlyssesAttention
+            self.attn = UlyssesAttention(world, interpret=interpret)
+        else:
+            self.attn = RingAttention(world, interpret=interpret)
         self._xs = CrossSliceAllReduce(world, mean=True)
         # ``optimizer``: any optax GradientTransformation; the default
         # matches the DP trainer. (The parity tests inject plain SGD —
@@ -133,6 +160,20 @@ class SeqParallelTrainer:
         self._freqs = rope_freqs(cfg.head_dim, cfg.max_seq_len,
                                  cfg.rope_theta)
 
+    # Attention-strategy adapter: both long-context strategies take the
+    # same sequence-sharded (q, k, v) and produce this rank's out/grads;
+    # ring carries an (out, lse) residual into backward, ulysses
+    # rematerializes and needs none.
+    def _attn_forward(self, q, k, v):
+        if self.sp_mode == "ulysses":
+            return self.attn.forward(q, k, v, causal=True), None
+        return self.attn.forward(q, k, v, causal=True)
+
+    def _attn_backward(self, q, k, v, out, lse, dout):
+        if self.sp_mode == "ulysses":
+            return self.attn.backward(q, k, v, dout, causal=True)
+        return self.attn.backward(q, k, v, out, lse, dout, causal=True)
+
     # --------------------------------------------------------- forward
 
     def _freqs_shard(self, s_local: int):
@@ -156,7 +197,7 @@ class SeqParallelTrainer:
         for i in range(self.cfg.n_layers):
             lp = p[f"layer_{i}"]
             q, k, v = self._qkv(lp, x, fr)
-            out, _ = self.ring_attention.forward(q, k, v, causal=True)
+            out, _ = self._attn_forward(q, k, v)
             x = self._post(lp, x, out)
         return self._logits(p["final_norm"], p["lm_head"], x)
 
@@ -189,7 +230,7 @@ class SeqParallelTrainer:
             lp = p[f"layer_{i}"]
             (q, k, v), pull_qkv = jax.vjp(
                 lambda lp_, x_: qkv_fn(lp_, x_, fr), lp, x)
-            out, lse = self.ring_attention.forward(q, k, v, causal=True)
+            out, lse = self._attn_forward(q, k, v)
             x, pull_post = jax.vjp(post_fn, lp, x, out)
             pulls.append((pull_qkv, pull_post))
             residuals.append((q, k, v, out, lse))
@@ -204,8 +245,7 @@ class SeqParallelTrainer:
             pull_qkv, pull_post = pulls[i]
             q, k, v, out, lse = residuals[i]
             g_post, dx, dout = pull_post(dx)
-            dq, dk, dv = self.ring_attention.backward(
-                q, k, v, out, lse, dout, causal=True)
+            dq, dk, dv = self._attn_backward(q, k, v, out, lse, dout)
             g_qkv, dx2 = pull_qkv((dq, dk, dv))
             dx = add(dx, dx2)
             grads[f"layer_{i}"] = add(g_post, g_qkv)
@@ -234,7 +274,7 @@ class SeqParallelTrainer:
         return gloss
 
     def close(self) -> None:
-        self.ring_attention.close()
+        self.attn.close()
         self._xs.close()
 
     def __enter__(self):

@@ -87,6 +87,10 @@ class Trainer:
                 "seq_parallel requires the Trainer base class "
                 "(__new__ dispatches to SeqParallelTrainer; subclasses "
                 "are not intercepted)")
+        if "sp_mode" in model_overrides:
+            raise ValueError(
+                "sp_mode selects the seq-parallel attention strategy "
+                "and requires seq_parallel=<RingWorld>")
         self.model = make_model(config, **model_overrides)
         self.cfg = self.model.cfg
         self.mesh = make_mesh(mesh_shape or {"dp": 1, "tp": 1}, devices)

@@ -86,6 +86,30 @@ def test_seq_parallel_training_matches_single_host(world_size):
     """N optimizer steps of the seq-parallel trainer reproduce
     single-host full-sequence training: per-step global losses AND the
     final parameters (ranks stay replicated)."""
+    _training_parity(world_size, "ring")
+
+
+def test_seq_parallel_training_ulysses_mode():
+    """The same parity contract holds with sp_mode='ulysses' (the
+    all-to-all strategy; llama-tiny's 2 KV heads divide world 2)."""
+    _training_parity(2, "ulysses")
+
+
+def test_seq_parallel_ulysses_rejects_indivisible_heads():
+    """llama-tiny has 2 KV heads: world 3 must fail at construction
+    on every rank, not stall mid-ring."""
+    from rocnrdma_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+    def rank_fn(r, world):
+        with pytest.raises(ValueError, match="divide"):
+            SeqParallelTrainer("llama-tiny", world, sp_mode="ulysses",
+                               interpret=True)
+        return True
+
+    assert all(_run_ranks(3, rank_fn, free_port() + 700))
+
+
+def _training_parity(world_size, sp_mode):
     import jax
     import jax.numpy as jnp
     import optax
@@ -107,7 +131,8 @@ def test_seq_parallel_training_matches_single_host(world_size):
 
     def rank_fn(r, world):
         tr = SeqParallelTrainer("llama-tiny", world, seed=0,
-                                interpret=True, optimizer=optax.sgd(lr))
+                                interpret=True, optimizer=optax.sgd(lr),
+                                sp_mode=sp_mode)
         sl = slice(r * s_local, (r + 1) * s_local)
         losses = []
         for tok in data:
