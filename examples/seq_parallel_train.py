@@ -9,6 +9,7 @@ one process here (the same code runs one-process-per-host across real
 slices).
 
     python examples/seq_parallel_train.py --world 3 --steps 3
+    python examples/seq_parallel_train.py --world 2 --mode ulysses
 """
 import argparse
 import os
@@ -25,6 +26,9 @@ def main():
     ap.add_argument("--world", type=int, default=2)
     ap.add_argument("--seq-local", type=int, default=16)
     ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--mode", choices=["ring", "ulysses"], default="ring",
+                    help="attention strategy: K/V rotation (ring) or "
+                         "all-to-all head resharding (ulysses)")
     ap.add_argument("--port", type=int, default=26700)
     args = ap.parse_args()
 
@@ -51,7 +55,7 @@ def main():
         # runner when seq_parallel is a RingWorld.
         try:
             tr = Trainer("llama-tiny", seq_parallel=worlds[r], seed=0,
-                         interpret=True)
+                         interpret=True, sp_mode=args.mode)
             sl_ = slice(r * sl, (r + 1) * sl)
             ls = []
             for tok in data:

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Ring vs Ulysses sequence parallelism — same shapes, same transport,
+side by side (CPU host, interpret-mode kernels).
+
+Both long-context strategies are exact (each is parity-tested against
+the full-sequence reference); what differs is how they use the
+transport. This record makes that difference third-party-checkable at
+identical shapes:
+
+- per-rank wire bytes per fwd+bwd call (ring: (W-1) K/V rotations
+  forward, (W-1) K/V + W accumulator rotations backward; ulysses: 11
+  all-to-alls — q/k/v/out forward, q/k/v/dout/dq/dk/dv backward (the
+  backward reshards its own operand copies; nothing is shared with the
+  forward) — each moving (W-1)/W of its tensor per rank);
+- measured host-staging bytes (collectives.staging — every D2H/H2D
+  bounce both strategies pay today);
+- wall time (CAVEAT: single-core host + interpret-mode kernels, so
+  compute dominates and wall is NOT a perf number — the bytes are the
+  datapoint; kernel-bound comparisons belong on the chip).
+
+Writes SP_COMPARE_CPU_<round>.json at the repo root.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _tpu_common import run_ranks  # noqa: E402
+
+from rocnrdma_tpu.utils.hostenv import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
+
+import numpy as np  # noqa: E402
+
+ROUND = os.environ.get("TDR_ROUND", "r05")
+OUT = os.path.join(REPO, f"SP_COMPARE_CPU_{ROUND}.json")
+
+
+def run_strategy(kind: str, worlds, shards, iters: int):
+    from rocnrdma_tpu.collectives.ring_attention import RingAttention
+    from rocnrdma_tpu.collectives.staging import staging
+    from rocnrdma_tpu.collectives.ulysses import UlyssesAttention
+
+    W = len(worlds)
+    attns = [(RingAttention if kind == "ring" else UlyssesAttention)(
+        w, interpret=True) for w in worlds]
+
+    def fwd_bwd(r):
+        q, k, v, do = shards[r]
+        a = attns[r]
+        if kind == "ring":
+            out, lse = a.forward(q, k, v, causal=True)
+            a.backward(q, k, v, out, lse, do, causal=True)
+        else:
+            a.forward(q, k, v, causal=True)
+            a.backward(q, k, v, do, causal=True)
+
+    def run_all():
+        run_ranks(W, fwd_bwd)
+
+    run_all()  # warm: compiles + staging buffers
+    staging.reset()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_all()
+    wall = (time.perf_counter() - t0) / iters
+    # Per RANK, like the wire columns: the staging counter is global
+    # across the W rank threads of this process.
+    staged = staging.bytes // iters // W
+    for a in attns:
+        a.close()
+    return {"wall_s_per_call": round(wall, 3),
+            "staged_bytes_per_rank_per_call": int(staged)}
+
+
+def main():
+    W = 2
+    B, H, KVH, S_local, D = 1, 4, 2, 128, 64
+    esz = 4  # float32
+    rng = np.random.default_rng(0)
+
+    def mk(h):
+        return rng.standard_normal((B, h, S_local, D)).astype(np.float32)
+
+    shards = [(mk(H), mk(KVH), mk(KVH), mk(H)) for _ in range(W)]
+    from rocnrdma_tpu.collectives.world import local_worlds
+    worlds = local_worlds(W, 27500 + (os.getpid() % 300))
+
+    kv = B * KVH * S_local * D * esz * 2        # K+V shard
+    qlike = B * H * S_local * D * esz           # q/out/dout/dq shard
+    acc = 2 * B * KVH * S_local * D * 4         # ring dK/dV f32 accumulator
+    ring_wire = (W - 1) * kv + ((W - 1) * kv + W * acc)
+    # 11 tensor all-to-alls per fwd+bwd — forward: q,k,v,out (4);
+    # backward: q,k,v,dout,dq,dk,dv (7; the backward reshards its own
+    # operand copies) — each moving (W-1)/W of its tensor per rank.
+    a2a_tensors_fwd = [qlike, kv // 2, kv // 2, qlike]
+    a2a_tensors_bwd = [qlike, kv // 2, kv // 2, qlike,
+                       qlike, kv // 2, kv // 2]
+    uly_wire = sum(a2a_tensors_fwd + a2a_tensors_bwd) * (W - 1) // W
+
+    out = {
+        "world": W,
+        "shape": {"B": B, "H": H, "KVH": KVH, "S_local": S_local,
+                  "D": D, "dtype": "float32"},
+        "caveat": ("single-core host + interpret-mode kernels: compute "
+                   "dominates wall; the BYTES columns are the "
+                   "strategy-difference datapoint"),
+        "units": "wire and staged columns are PER RANK per fwd+bwd call",
+        "ring_wire_bytes_per_rank_per_call": ring_wire,
+        "ulysses_wire_bytes_per_rank_per_call": uly_wire,
+    }
+    try:
+        out["ring"] = run_strategy("ring", worlds, shards, iters=2)
+        out["ulysses"] = run_strategy("ulysses", worlds, shards, iters=2)
+    finally:
+        for w in worlds:
+            w.close()
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
