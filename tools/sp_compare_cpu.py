@@ -101,7 +101,11 @@ def main():
     a2a_tensors_fwd = [qlike, kv // 2, kv // 2, qlike]
     a2a_tensors_bwd = [qlike, kv // 2, kv // 2, qlike,
                        qlike, kv // 2, kv // 2]
-    uly_wire = sum(a2a_tensors_fwd + a2a_tensors_bwd) * (W - 1) // W
+    # Per-LINK bytes of the ring bundle-shrink all-to-all: w(w-1)/2
+    # segments of size T/w cross each link -> T*(w-1)/2 per tensor
+    # (matches the ring column's per-link convention; equals (w-1)/w
+    # only at w=2).
+    uly_wire = sum(a2a_tensors_fwd + a2a_tensors_bwd) * (W - 1) // 2
 
     out = {
         "world": W,
