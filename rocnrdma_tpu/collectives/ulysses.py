@@ -10,9 +10,10 @@ positions) into a head-sharded one (every rank: H/W heads, the FULL
 sequence), local flash attention runs unmodified on the full
 sequence for its head subset, and a second all-to-all converts the
 output back. Two collectives per call versus the ring's W-1
-rotations; the trade is wire volume (each all-to-all moves
-(W-1)/W of the tensor once) against the ring's overlap-friendly
-step structure.
+rotations; the trade is wire volume (each all-to-all reshards its
+full tensor once — (W-1)/2 of it crosses each ring link on the
+bundle-shrink schedule) against the ring's overlap-friendly step
+structure.
 
 Transport role (SURVEY §5 L5 consumer): the resharding rides
 ``RingWorld.all_to_all`` — the bundle-shrink ring schedule in
@@ -32,6 +33,8 @@ scattered axis); any ``S_local`` works.
 """
 
 from __future__ import annotations
+
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -63,8 +66,22 @@ class UlyssesAttention:
         # only because each collective call fully consumes the buffer
         # before the next begins (calls are serial per instance).
         self._bufs = {}
+        # Seconds the LAST forward()/backward() spent resharding
+        # (D2H + pack + all-to-all + unpack + H2D) — the strategy's
+        # whole transport cost, the RingAttention.last_wait_s analogue.
+        self.last_reshard_s = 0.0
 
     # ------------------------------------------------------- resharding
+
+    @staticmethod
+    def _fence(t):
+        """Force device completion of ``t`` before reshard timing
+        starts — else the kernel's execution time (which the full-D2H
+        below would otherwise absorb) leaks into last_reshard_s.
+        One-element materialization: block_until_ready is not a
+        trustworthy fence on the tunnel (tools/tpu_extra.py)."""
+        if getattr(t, "ndim", 0):
+            np.asarray(t[(0,) * t.ndim])
 
     def _staging(self, nbytes: int):
         """Reused uint8 staging buffer (byte semantics: the exchange
@@ -92,6 +109,8 @@ class UlyssesAttention:
         block j of the local sequence shard; after the exchange it
         holds this rank's head block of rank j's (= sequence block
         j's) positions."""
+        self._fence(x)
+        t0 = time.perf_counter()
         w = self.world.world
         b, h, s, d = x.shape
         hw = self._check(h, "heads")
@@ -106,7 +125,10 @@ class UlyssesAttention:
         self.world.all_to_all(buf)
         blocks = buf.view(host.dtype).reshape(w, b, hw, s, d)
         full = np.concatenate([blocks[j] for j in range(w)], axis=2)
-        return jnp.asarray(full)
+        out = jnp.asarray(full)
+        self._fence(out)  # charge the H2D tail to the reshard, not compute
+        self.last_reshard_s += time.perf_counter() - t0
+        return out
 
     def _head_to_seq(self, y):
         """(B, h/W, W*S_local, D) head-sharded → (B, h, S_local, D)
@@ -117,6 +139,8 @@ class UlyssesAttention:
         if sg % w != 0:
             raise ValueError(
                 f"ulysses: global sequence {sg} must divide by world={w}")
+        self._fence(y)
+        t0 = time.perf_counter()
         s = sg // w
         host = np.ascontiguousarray(np.asarray(y))  # D2H
         buf = self._staging(host.nbytes)
@@ -129,7 +153,10 @@ class UlyssesAttention:
         self.world.all_to_all(buf)
         blocks = buf.view(host.dtype).reshape(w, b, hw, s, d)
         full = np.concatenate([blocks[j] for j in range(w)], axis=1)
-        return jnp.asarray(full)
+        out = jnp.asarray(full)
+        self._fence(out)  # charge the H2D tail to the reshard, not compute
+        self.last_reshard_s += time.perf_counter() - t0
+        return out
 
     # ------------------------------------------------------- attention
 
@@ -139,6 +166,7 @@ class UlyssesAttention:
 
     def forward(self, q, k, v, causal: bool = True):
         """Sequence-parallel attention output for this rank's shard."""
+        self.last_reshard_s = 0.0
         q = jnp.asarray(q)
         qf = self._seq_to_head(q)
         kf = self._seq_to_head(jnp.asarray(k))
@@ -154,6 +182,7 @@ class UlyssesAttention:
         """Exact (dq, dk, dv) for this rank's shard. The head-sharded
         forward recomputes inside ``jax.vjp`` (rematerialization);
         gradients reshard home through the same all-to-alls."""
+        self.last_reshard_s = 0.0
         qf = self._seq_to_head(jnp.asarray(q))
         kf = self._seq_to_head(jnp.asarray(k))
         vf = self._seq_to_head(jnp.asarray(v))

@@ -11,7 +11,8 @@ identical shapes:
   forward, (W-1) K/V + W accumulator rotations backward; ulysses: 11
   all-to-alls — q/k/v/out forward, q/k/v/dout/dq/dk/dv backward (the
   backward reshards its own operand copies; nothing is shared with the
-  forward) — each moving (W-1)/W of its tensor per rank);
+  forward) — each putting (W-1)/2 of its tensor on every ring link,
+  the bundle-shrink schedule's per-link cost);
 - measured host-staging bytes (collectives.staging — every D2H/H2D
   bounce both strategies pay today);
 - wall time (CAVEAT: single-core host + interpret-mode kernels, so
@@ -97,7 +98,7 @@ def main():
     ring_wire = (W - 1) * kv + ((W - 1) * kv + W * acc)
     # 11 tensor all-to-alls per fwd+bwd — forward: q,k,v,out (4);
     # backward: q,k,v,dout,dq,dk,dv (7; the backward reshards its own
-    # operand copies) — each moving (W-1)/W of its tensor per rank.
+    # operand copies).
     a2a_tensors_fwd = [qlike, kv // 2, kv // 2, qlike]
     a2a_tensors_bwd = [qlike, kv // 2, kv // 2, qlike,
                        qlike, kv // 2, kv // 2]

@@ -54,6 +54,9 @@ while true; do
     python tools/ring_attention_tpu_demo.py || {
       rechase "ringattn demo"; continue; }
   fi
+  if [ ! -f "TPU_RESULTS_${ROUND}_ulysses.json" ]; then
+    python tools/ulysses_tpu_demo.py || { rechase "ulysses demo"; continue; }
+  fi
   if ! grep -q attn_block_tuning "TPU_RESULTS_${ROUND}_extra.json" 2>/dev/null \
      || ! grep -q rmsnorm_block_tuning "TPU_RESULTS_${ROUND}_extra.json" 2>/dev/null; then
     TDR_EXTRA_SECTIONS=tune python tools/tpu_extra.py || {

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Ulysses resharding datapoint on the real TPU — the all-to-all
+counterpart of tools/ring_attention_tpu_demo.py.
+
+Two in-process ranks share the chip: the head<->sequence resharding
+runs on the host transport (emu ring all-to-all) while flash
+attention runs on the TPU for each rank's head subset. Reports, per
+fwd+bwd call: wall time, the time inside resharding
+(``UlyssesAttention.last_reshard_s`` — D2H + pack + all-to-all +
+unpack + H2D, the strategy's whole transport cost), its fraction of
+wall, and the derived per-rank reshard GB/s. Same shapes as the ring
+demo so the two strategies' on-chip records compare directly.
+
+Writes TPU_RESULTS_<round>_ulysses.json; appends to the attempt log.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _tpu_common import ROUND, accel_devices, log_attempt, run_ranks  # noqa: E402
+
+TOOL = "ulysses_tpu_demo"
+RESULTS = os.path.join(REPO, f"TPU_RESULTS_{ROUND}_ulysses.json")
+
+
+def main():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    devs = accel_devices()
+    if not devs:
+        log_attempt(TOOL, {"ok": False, "error": "no accelerator devices"})
+        print(json.dumps({"error": "no accelerator devices"}))
+        return 1
+    dev = devs[0]
+
+    from rocnrdma_tpu.collectives.staging import staging
+    from rocnrdma_tpu.collectives.ulysses import UlyssesAttention
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    W = 2
+    B, H, KVH, S_local, D = 1, 16, 8, 2048, 128
+    dtype = jnp.bfloat16
+    rng = np.random.default_rng(0)
+
+    def shard(h):
+        a = rng.standard_normal((B, h, S_local, D)).astype(np.float32)
+        return jax.device_put(jnp.asarray(a, dtype), dev)
+
+    qs = [shard(H) for _ in range(W)]
+    ks = [shard(KVH) for _ in range(W)]
+    vs = [shard(KVH) for _ in range(W)]
+    dos = [shard(H) for _ in range(W)]
+    # Per-rank reshard payload per fwd+bwd: 11 tensor all-to-alls —
+    # 5 q-like (fwd q/out, bwd q/dout/dq) + 6 kv-like (fwd k/v, bwd
+    # k/v/dk/dv) — each resharding its full tensor once.
+    tensor_bytes = 5 * qs[0].nbytes + 3 * (ks[0].nbytes + vs[0].nbytes)
+    out = {
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "platform": dev.platform,
+        "shape": {"B": B, "H": H, "KVH": KVH, "S_local": S_local, "D": D,
+                  "dtype": str(np.dtype("bfloat16"))},
+        "reshard_payload_bytes_per_call": tensor_bytes,
+        "caveat": ("two ranks share one chip (kernels serialize on the "
+                   "MXU) and one host core; the reshard FRACTION is "
+                   "the evidence, absolute GB/s is tunnel-bound"),
+    }
+
+    worlds = local_worlds(W, 29900 + (os.getpid() % 300))
+    uas = [UlyssesAttention(w) for w in worlds]
+    try:
+        def fwd_bwd(r):
+            ua = uas[r]
+            o = ua.forward(qs[r], ks[r], vs[r], causal=True)
+            fr = ua.last_reshard_s
+            # One-element materialization (not block_until_ready —
+            # broken fence on this tunnel, tools/tpu_extra.py).
+            np.asarray(o[(0,) * o.ndim])
+            g = ua.backward(qs[r], ks[r], vs[r], dos[r], causal=True)
+            br = ua.last_reshard_s
+            np.asarray(g[0][(0,) * g[0].ndim])
+            return fr, br
+
+        run_ranks(W, fwd_bwd)  # warm: compiles + staging buffers
+        staging.reset()
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res = run_ranks(W, fwd_bwd)
+        wall = (time.perf_counter() - t0) / iters
+        fr = max(r[0] for r in res)
+        br = max(r[1] for r in res)
+        out["wall_s_per_call"] = round(wall, 4)
+        out["fwd_reshard_s"] = round(fr, 4)
+        out["bwd_reshard_s"] = round(br, 4)
+        out["reshard_fraction"] = round((fr + br) / wall, 3)
+        out["reshard_GBps_per_rank"] = round(
+            tensor_bytes / (fr + br) / 1e9, 3)
+        # Per RANK like the payload/GBps keys (the counter is global
+        # across both rank threads).
+        out["staged_bytes_per_rank_per_call"] = staging.bytes // iters // W
+    finally:
+        for ua in uas:
+            ua.close()
+        for w in worlds:
+            w.close()
+
+    with open(RESULTS, "w") as f:
+        json.dump(out, f, indent=1)
+    log_attempt(TOOL, {"ok": True,
+                       "reshard_fraction": out.get("reshard_fraction")})
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        # sys.exit(main()) lands here on every return path; main()
+        # already logged its own failures, so never double-log.
+        raise
+    except BaseException as e:  # noqa: BLE001 — every run must log
+        log_attempt(TOOL, {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"[:400]})
+        raise
