@@ -142,6 +142,34 @@ def bench_allreduce(count=(1 << 30) // 4, world=2, iters=3):
     return nbytes * 2 * (world - 1) / world / dt / 1e9
 
 
+def bench_alltoall(count=(256 << 20) // 4, world=2, iters=3):
+    """Ring all-to-all per-link bandwidth: (world-1)/2 of the buffer
+    crosses each link per call (bundle-shrink schedule)."""
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    worlds = local_worlds(world, _free_port())
+    count -= count % world
+    bufs = [np.arange(count, dtype=np.float32) * (r + 1)
+            for r in range(world)]
+
+    def run_all():
+        ts = [threading.Thread(target=worlds[r].all_to_all,
+                               args=(bufs[r],)) for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    run_all()  # warmup (scratch MR setup)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_all()
+    dt = (time.perf_counter() - t0) / iters
+    for w in worlds:
+        w.close()
+    return count * 4 * (world - 1) / 2 / dt / 1e9
+
+
 def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
     """Staged-fallback throughput: a pytree of numpy leaves with NO
     exporter takes the gather → ring → scatter path (the only path
@@ -470,6 +498,10 @@ def main():
     bus = bench_allreduce(count=sizes["ar_count"])
     details["allreduce_world"] = 2
     details["allreduce_bytes"] = sizes["ar_bytes"]
+    # all-to-all datapoint: PER-LINK bandwidth ((world-1)/2 of the
+    # buffer crosses each link on the bundle-shrink schedule).
+    details["alltoall_world2_link_GBps"] = round(
+        bench_alltoall(count=sizes["w4_count"], world=2, iters=2), 3)
     # world>2 datapoint (wavefront schedule with last-RS-step
     # foldback): smaller buffer so four in-process ranks stay within
     # the CI box. Same bus-bandwidth convention and roofline context

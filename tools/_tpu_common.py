@@ -56,3 +56,18 @@ def run_ranks(world: int, fn) -> list:
         raise RuntimeError(
             f"rank {errs[0][0]} failed:\n{errs[0][2]}") from errs[0][1]
     return results
+
+
+def fence_one(t):
+    """Force device completion of ``t`` by materializing ONE element —
+    the only trustworthy fence on this tunnel (block_until_ready can
+    return early; see tools/tpu_extra.py). The embedded subprocess
+    bench scripts (tpu_chase/tpu_extra BENCH strings) carry their own
+    inline copies by design (they run via python -c, self-contained);
+    importing tools keep exactly this one.
+    """
+    import numpy as np
+    leaf = t
+    if getattr(leaf, "ndim", 0):
+        leaf = leaf[(0,) * leaf.ndim]
+    return np.asarray(leaf)

@@ -30,7 +30,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _tpu_common import ROUND, accel_devices, log_attempt, run_ranks  # noqa: E402
+from _tpu_common import (  # noqa: E402
+    ROUND, accel_devices, fence_one, log_attempt, run_ranks)
 
 TOOL = "ring_attention_tpu_demo"
 RESULTS = os.path.join(REPO, f"TPU_RESULTS_{ROUND}_ringattn.json")
@@ -85,11 +86,7 @@ def main():
             os.environ["TDR_RA_NO_OVERLAP"] = env
 
             def _sync(t):
-                # block_until_ready is not a trustworthy fence on this
-                # tunnel (see tools/tpu_extra.py); materializing one
-                # element forces real completion at 4-byte D2H cost.
-                leaf = jax.tree_util.tree_leaves(t)[0]
-                np.asarray(leaf[(0,) * leaf.ndim])
+                fence_one(jax.tree_util.tree_leaves(t)[0])
 
             def fwd_bwd(r):
                 o, lse = ras[r].forward(qs[r], ks[r], vs[r], causal=True)

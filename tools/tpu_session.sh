@@ -6,7 +6,8 @@
 #      as missing (merge_bank keeps previously banked keys)
 #   2. staged_tpu_demo  (pipelined-vs-serial staged allreduce on chip)
 #   3. ring_attention_tpu_demo  (overlap hidden-fraction on chip)
-#   4. tpu_extra tune section (block-size sweep) — lowest priority
+#   4. ulysses_tpu_demo  (all-to-all reshard fraction on chip)
+#   5. tpu_extra tune section (block-size sweep) — lowest priority
 # Every stage is guarded by "is its artifact already banked?" so a
 # mid-queue tunnel death never re-burns a later window re-measuring
 # banked data. Attempts land in TPU_ATTEMPTS_r05.jsonl either way.

@@ -22,7 +22,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from _tpu_common import ROUND, accel_devices, log_attempt, run_ranks  # noqa: E402
+from _tpu_common import (  # noqa: E402
+    ROUND, accel_devices, fence_one, log_attempt, run_ranks)
 
 TOOL = "ulysses_tpu_demo"
 RESULTS = os.path.join(REPO, f"TPU_RESULTS_{ROUND}_ulysses.json")
@@ -80,12 +81,10 @@ def main():
             ua = uas[r]
             o = ua.forward(qs[r], ks[r], vs[r], causal=True)
             fr = ua.last_reshard_s
-            # One-element materialization (not block_until_ready —
-            # broken fence on this tunnel, tools/tpu_extra.py).
-            np.asarray(o[(0,) * o.ndim])
+            fence_one(o)
             g = ua.backward(qs[r], ks[r], vs[r], dos[r], causal=True)
             br = ua.last_reshard_s
-            np.asarray(g[0][(0,) * g[0].ndim])
+            fence_one(g[0])
             return fr, br
 
         run_ranks(W, fwd_bwd)  # warm: compiles + staging buffers
