@@ -1158,6 +1158,21 @@ int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
       });
 }
 
+namespace {
+// The alltoall staging can dwarf what any other collective retains:
+// keep small scratch cached (steady-state reuse) but release
+// oversized growth rather than pinning it for the ring's lifetime.
+void release_big_scratch(tdr_ring *r, size_t total) {
+  if (total <= (64u << 20)) return;
+  if (r->tmp_mr) {
+    tdr_dereg_mr(r->tmp_mr);
+    r->tmp_mr = nullptr;
+  }
+  r->tmp.clear();
+  r->tmp.shrink_to_fit();
+}
+}  // namespace
+
 /* In-place all-to-all (MPI_Alltoall with MPI_IN_PLACE semantics):
  * ``data`` holds ``world`` equal segments; segment j is FOR rank j on
  * entry and FROM rank j on return (this rank's own segment is
@@ -1189,10 +1204,52 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
   const size_t segsz = count / world * esz;
   const int rank = r->rank;
   const size_t steps = static_cast<size_t>(world) - 1;
-  // No data MR: unlike the other collectives, the user buffer never
-  // touches the wire here — bundles stage through the scratch MR and
-  // the buffer is only memcpy'd, so registering it would be a pure
-  // per-call pin/unpin tax.
+
+  if (world == 2) {
+    // Direct exchange: ONE foreign segment each way. Stage only the
+    // outgoing segment (its slot in `data` is about to be overwritten
+    // by the inbound one — sending straight from `data` would race
+    // the landing recv), receive the peer's segment directly into
+    // place. One local copy instead of the bundle path's three.
+    const size_t peer = static_cast<size_t>(1 - rank);
+    char *db = static_cast<char *>(data);
+    // Prefer a caller-registered full-buffer MR (front-loaded
+    // registration); otherwise pin ONLY the received segment — the
+    // wire never touches the rest of the buffer.
+    tdr_mr *dmr = nullptr;
+    bool owned = false;
+    size_t roff = peer * segsz;
+    auto it = r->registered.find(reinterpret_cast<uint64_t>(data));
+    if (it != r->registered.end() &&
+        tdr_mr_len(it->second) >= count * esz) {
+      dmr = it->second;
+    } else {
+      dmr = tdr_reg_mr(r->eng, db + peer * segsz, segsz, 0);
+      owned = true;
+      roff = 0;
+    }
+    if (!dmr) return -1;
+    OwnedMrGuard guard{dmr, owned};
+    (void)guard;
+    tdr_mr *smr = r->scratch(segsz);
+    if (!smr) return -1;
+    std::memcpy(r->tmp.data(), db + peer * segsz, segsz);
+    ChainPump pump{r, /*n_recv=*/1, /*n_send=*/1, 1, 1, /*head=*/true,
+                   "ring(alltoall2)"};
+    int rc = pump.run(
+        [&](size_t) {
+          return tdr_post_recv(r->left, dmr, roff, segsz, kWrRecv | 0);
+        },
+        [&](size_t) {
+          return tdr_post_send(r->right, smr, 0, segsz, kWrSend | 0);
+        });
+    if (rc == 0) release_big_scratch(r, segsz);
+    return rc;
+  }
+  // No data MR on the general path: the user buffer never touches the
+  // wire — bundles stage through the scratch MR and the buffer is
+  // only memcpy'd, so registering it would be a pure per-call
+  // pin/unpin tax.
 
   // Scratch: the outgoing first bundle (w-1 segments) + one receive
   // slot per step, slot ri sized (w-1-ri) segments.
@@ -1242,18 +1299,7 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
     std::memcpy(db + static_cast<size_t>(src) * segsz, sb + slot_off[ri],
                 segsz);
   }
-  // The bundle scheme needs ~(w/2)x the buffer in scratch — far more
-  // than any other collective retains. Keep small scratch cached (the
-  // steady-state allreduce case) but release oversized growth rather
-  // than pinning it for the ring's lifetime.
-  if (total > (64u << 20)) {
-    if (r->tmp_mr) {
-      tdr_dereg_mr(r->tmp_mr);
-      r->tmp_mr = nullptr;
-    }
-    r->tmp.clear();
-    r->tmp.shrink_to_fit();
-  }
+  release_big_scratch(r, total);
   return 0;
 }
 

@@ -133,6 +133,37 @@ def test_all_to_all_is_the_segment_transpose(world_size, dtype):
         w.close()
 
 
+def test_all_to_all_large_buffer_releases_scratch_and_ring_still_works():
+    """An all-to-all whose bundle scratch exceeds the 64 MiB retention
+    cap releases it after the call (the scheme needs ~(w/2)x the
+    buffer — far more than any other collective retains); correctness
+    must hold through the release, through a SECOND large call that
+    re-registers scratch, and for a subsequent allreduce that regrows
+    its own (smaller) scratch."""
+    # world 3: world 2 takes the direct-exchange fast path whose
+    # single-segment scratch stays under the cap; the bundle scheme
+    # (and its release) engages at w >= 3.
+    world = 3
+    worlds = local_worlds(world, free_port() + 250)
+    n = (96 << 20) // 4 // 3 * 3  # ~96 MiB/rank -> ~160 MiB scratch > 64 MiB cap
+    base = [np.arange(n, dtype=np.float32) + 1000.0 * r
+            for r in range(world)]
+    bufs = [b.copy() for b in base]
+    for _ in range(2):  # second call exercises scratch re-registration
+        run_ranks(worlds, lambda w, r: w.all_to_all(bufs[r]))
+    # Two transposes = identity.
+    for r in range(world):
+        np.testing.assert_array_equal(bufs[r], base[r])
+
+    small = [np.ones(1024, dtype=np.float32) * (r + 1)
+             for r in range(world)]
+    run_ranks(worlds, lambda w, r: w.allreduce(small[r]))
+    for r in range(world):
+        np.testing.assert_array_equal(small[r], np.full(1024, 6.0))
+    for w in worlds:
+        w.close()
+
+
 @pytest.mark.parametrize("world_size", [2, 3, 4])
 def test_broadcast(world_size):
     """Every rank ends with root's bytes; non-root inputs are
