@@ -442,7 +442,11 @@ def annotate_missing(results: dict) -> dict:
 
 
 def main():
-    timeout_s = int(os.environ.get("TDR_CHASE_TIMEOUT_S", "1200"))
+    # Own budget, NOT the chase probe's: the session driver runs the
+    # cheap chase with a tight TDR_CHASE_TIMEOUT_S, but the train
+    # section alone needs two model compiles through the tunnel — a
+    # 600s cap would guarantee the deep run never completes.
+    timeout_s = int(os.environ.get("TDR_EXTRA_TIMEOUT_S", "1200"))
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     t0 = time.time()
     rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
