@@ -55,6 +55,13 @@ class LlamaConfig:
     # a 1B-model train step at seq 2048 exceeds a v5e chip's 16 GiB.
     # Applies to training forwards only (decode has no backward).
     remat: bool = False
+    # Remat recompute policy: "full" recomputes everything (minimum
+    # memory); "dots" saves matmul outputs and recomputes only the
+    # cheap elementwise work (jax.checkpoint_policies
+    # .dots_with_no_batch_dims_saveable) — fewer backward FLOPs for
+    # O(layers x tokens x d_ff) more HBM, the standard lever when the
+    # chip has headroom and MFU is the target.
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -314,7 +321,16 @@ class Llama(nn.Module):
         new_cache = {} if cache is not None else None
         block_cls = Block
         if cfg.remat and cache is None:
-            block_cls = nn.remat(Block)
+            if cfg.remat_policy == "dots":
+                block_cls = nn.remat(
+                    Block, policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            elif cfg.remat_policy == "full":
+                block_cls = nn.remat(Block)
+            else:
+                raise ValueError(
+                    f"remat_policy={cfg.remat_policy!r}: must be "
+                    "'full' or 'dots'")
         for i in range(cfg.n_layers):
             layer_cache = cache[f"layer_{i}"] if cache is not None else None
             x, lc = block_cls(cfg, name=f"layer_{i}")(x, freqs, layer_cache,
@@ -332,6 +348,13 @@ class Llama(nn.Module):
 
 def make_model(config: "LlamaConfig | str", **overrides) -> Llama:
     cfg = CONFIGS[config] if isinstance(config, str) else config
+    if (overrides.get("remat_policy", cfg.remat_policy)
+            not in ("full", "dots")):
+        # Fail at the config site, not trace time deep inside jit.
+        raise ValueError(
+            f"remat_policy="
+            f"{overrides.get('remat_policy', cfg.remat_policy)!r}: "
+            "must be 'full' or 'dots'")
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
     return Llama(cfg)

@@ -254,6 +254,19 @@ def test_remat_loss_and_grad_parity():
         lambda a, b: float(jnp.max(jnp.abs(a - b))), g0, g1)
     assert max(jax.tree_util.tree_leaves(diffs)) < 1e-5
 
+    # The dots policy (save matmul outputs, recompute elementwise —
+    # the MFU lever) is the same pure trade; bogus policies reject.
+    m2 = make_model("llama-tiny", remat=True, remat_policy="dots")
+    l2, g2 = jax.value_and_grad(loss_fn(m2))(params)
+    assert abs(float(l0) - float(l2)) < 1e-6
+    diffs2 = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), g0, g2)
+    assert max(jax.tree_util.tree_leaves(diffs2)) < 1e-5
+    import pytest
+    with pytest.raises(ValueError, match="remat_policy"):
+        make_model("llama-tiny", remat=True,
+                   remat_policy="bogus").apply(params, tok[:, :-1])
+
 
 def test_flagship_8b_train_step_traces_abstractly():
     """The FULL Llama-3-8B training step — init, fwd, loss, grad,
