@@ -268,14 +268,16 @@ def test_remat_loss_and_grad_parity():
                    remat_policy="bogus").apply(params, tok[:, :-1])
 
 
-def test_flagship_8b_train_step_traces_abstractly():
+@pytest.mark.parametrize("policy", ["full", "dots"])
+def test_flagship_8b_train_step_traces_abstractly(policy):
     """The FULL Llama-3-8B training step — init, fwd, loss, grad,
     adamw update — traces end to end at the flagship geometry without
     materializing its ~16 GiB of parameters (jax.eval_shape: abstract
     values only). Catches geometry bugs (head split, GQA grouping,
     d_ff wiring) at the size that actually ships, which no executed
     test on this box could afford. remat=True is the production
-    setting for this size (see LlamaConfig.remat)."""
+    setting for this size (see LlamaConfig.remat); both recompute
+    policies must trace."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -283,7 +285,7 @@ def test_flagship_8b_train_step_traces_abstractly():
     from rocnrdma_tpu.models.llama import (
         cross_entropy_loss, make_model)
 
-    model = make_model("llama3-8b", remat=True)
+    model = make_model("llama3-8b", remat=True, remat_policy=policy)
     tx = optax.adamw(1e-4)
     tokens = jax.ShapeDtypeStruct((2, 2049), jnp.int32)
     rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
