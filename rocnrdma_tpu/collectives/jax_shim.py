@@ -129,6 +129,12 @@ class CrossSliceAllReduce:
         self._regmgr: Optional[RegistrationManager] = None
         # Worker for the staged pipeline's ring ops (lazy).
         self._stage_ex: Optional[ThreadPoolExecutor] = None
+        # One-shot training-step stamp for the next schedule-digest
+        # exchange (set_step_token): lets the elastic trainer verify
+        # that every rank resumed at the SAME step — ranks whose
+        # checkpoints rewound differently would otherwise silently
+        # average gradients from different batches.
+        self._step_token: Optional[int] = None
 
     # -------------------------------------------------- zero-copy path
 
@@ -369,6 +375,12 @@ class CrossSliceAllReduce:
             "s:{}:{}".format(d, ",".join(str(int(leaves[i].size))
                                          for i in idxs))
             for d, idxs in groups.items()]
+        if self._step_token is not None:
+            # Every rank must have stamped the same step (all set it
+            # for their first post-(re)build sync); a rank that
+            # restored a different checkpoint fails the digest here —
+            # fatal, because batch desync is not cured by rebuilding.
+            sched.append(f"step:{self._step_token}")
         describe = " ".join(sched)
         unhold = getattr(self.exporter, "unhold", None)
         # reg_mr on a pinning engine (verbs) pins PHYSICAL pages: if
@@ -382,6 +394,9 @@ class CrossSliceAllReduce:
             check = getattr(self.world, "check_schedule", None)
             if check is not None:
                 check(hashlib.sha256(describe.encode()).digest(), describe)
+            # Stamp verified (or no checker): one-shot by design —
+            # steady-state digests go back to the cacheable form.
+            self._step_token = None
 
             for va, nbytes, arr in coalesced:
                 self._zero_copy(arr, va, nbytes)
@@ -589,6 +604,28 @@ class CrossSliceAllReduce:
             self._staging[dtype_str] = buf
             self.world.ring.register_buffer(buf)
         return buf
+
+    def set_step_token(self, step: int) -> None:
+        """Stamp the NEXT schedule-digest exchange with the training
+        step. The elastic trainer calls this for the first sync after
+        construction and after every resume; all ranks stamping the
+        same step is what proves their checkpoints agree before any
+        gradient is averaged."""
+        self._step_token = int(step)
+
+    def reset_transport_cache(self) -> None:
+        """Forget ring-bound state after ``RingWorld.rebuild()``: the
+        new incarnation's ring starts with an empty registration
+        table, so cached staging buffers must re-register and cached
+        zero-copy MRs re-pin/re-adopt on next use. The elastic trainer
+        calls this between rebuild and retry."""
+        self._staging.clear()
+        for key in list(self._regs):
+            try:
+                self._drop_cached(key)
+            except Exception:
+                pass
+        trace.event("xslice.cache_reset")
 
     def close(self) -> None:
         """Release the zero-copy registrations (unadopt from the ring,

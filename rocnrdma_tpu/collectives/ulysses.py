@@ -96,24 +96,27 @@ class UlyssesAttention:
             self._bufs[nbytes] = buf
         return buf
 
-    def _check(self, h: int, what: str) -> int:
+    def _check(self, h: int, label: str) -> int:
         w = self.world.world
         if h % w != 0:
             raise ValueError(
-                f"ulysses: {what}={h} must divide by world={w}")
+                f"ulysses: {label}={h} must divide by world={w}")
         return h // w
 
-    def _seq_to_head(self, x):
+    def _seq_to_head(self, x, label: str = "heads"):
         """(B, h, S_local, D) sequence-sharded → (B, h/W, W*S_local, D)
         head-sharded. Segment j of the all-to-all buffer carries head
         block j of the local sequence shard; after the exchange it
         holds this rank's head block of rank j's (= sequence block
-        j's) positions."""
+        j's) positions. ``label`` names the tensor's head axis in
+        indivisibility errors ('q heads' vs 'kv heads' — a GQA model
+        whose kv heads don't divide the world must say which axis is
+        at fault, not just "heads")."""
         self._fence(x)
         t0 = time.perf_counter()
         w = self.world.world
         b, h, s, d = x.shape
-        hw = self._check(h, "heads")
+        hw = self._check(h, label)
         host = np.ascontiguousarray(np.asarray(x))  # D2H
         buf = self._staging(host.nbytes)
         segb = host.nbytes // w
@@ -168,9 +171,9 @@ class UlyssesAttention:
         """Sequence-parallel attention output for this rank's shard."""
         self.last_reshard_s = 0.0
         q = jnp.asarray(q)
-        qf = self._seq_to_head(q)
-        kf = self._seq_to_head(jnp.asarray(k))
-        vf = self._seq_to_head(jnp.asarray(v))
+        qf = self._seq_to_head(q, "q heads")
+        kf = self._seq_to_head(jnp.asarray(k), "kv heads")
+        vf = self._seq_to_head(jnp.asarray(v), "kv heads")
         out_full = self._local(qf, kf, vf, causal)
         out = self._head_to_seq(out_full)
         trace.event("ulysses.forward", rank=self.world.rank,
@@ -183,10 +186,10 @@ class UlyssesAttention:
         forward recomputes inside ``jax.vjp`` (rematerialization);
         gradients reshard home through the same all-to-alls."""
         self.last_reshard_s = 0.0
-        qf = self._seq_to_head(jnp.asarray(q))
-        kf = self._seq_to_head(jnp.asarray(k))
-        vf = self._seq_to_head(jnp.asarray(v))
-        df = self._seq_to_head(jnp.asarray(dout))
+        qf = self._seq_to_head(jnp.asarray(q), "q heads")
+        kf = self._seq_to_head(jnp.asarray(k), "kv heads")
+        vf = self._seq_to_head(jnp.asarray(v), "kv heads")
+        df = self._seq_to_head(jnp.asarray(dout), "q heads")
         _, pull = jax.vjp(
             lambda q_, k_, v_: self._local(q_, k_, v_, causal),
             qf, kf, vf)

@@ -5,28 +5,55 @@ and MPI bring their own TCP bootstrap); here it is part of the
 framework. Each rank accepts a connection from its left neighbor on
 ``base_port + rank`` and dials its right neighbor at
 ``base_port + (rank+1) % world`` — a deadlock-free scheme because
-connects retry until the listener is up (tcp_connect_retry).
+connects retry until the listener is up (tcp_connect_retry) and the
+accept itself is deadline-bounded (no thread is ever stranded holding
+the port).
 
 Works identically for in-process multi-rank tests (one Engine per rank,
 threads), multi-process single-host, and multi-host (pass ``peers``).
+
+**Elasticity.** A world is an *incarnation* of the ring, identified by
+a monotonic ``generation`` number agreed at bootstrap (every rank
+proposes its own; the ring maximum wins, so a freshly-restarted rank
+adopts the survivors' count). ``rebuild()`` tears the incarnation down
+— leaving the Engine reusable — bumps the generation, and
+re-rendezvouses with exponential backoff + jitter under a bounded
+retry budget. The generation is stamped into every schedule-digest
+exchange, so traffic from a previous incarnation (a rank that missed
+the rebuild) is FENCED: it fails the digest comparison with an
+explicit stale-generation error instead of desynchronizing — let alone
+corrupting — the new ring.
 """
 
 from __future__ import annotations
 
 import os
+import random
+import struct
 import threading
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from rocnrdma_tpu.transport.engine import (Engine, QueuePair, Ring, RED_SUM,
-                                           TransportError)
+                                           TransportError,
+                                           note_fault_injections)
 from rocnrdma_tpu.utils.trace import trace
 
 # wr_id tags for the schedule-digest exchange — distinct from the
 # ring's kWrRecv/kWrSend tag space (0x5245/0x5345 << 48).
 _WR_DIGEST_RECV = 0x4447 << 48
 _WR_DIGEST_SEND = (0x4447 << 48) | 1
+
+# Digest frame: 32 digest bytes + 8 generation bytes + 1 status/pad
+# byte = 41, deliberately indivisible by every ring dtype size: if
+# steady-state skew ever mismatches a digest frame against a posted
+# reduce-recv, the fold VALIDATION rejects it — the frame can error a
+# step but can never be silently summed into a live gradient buffer.
+_DG_BYTES = 41
+# Generation frame: 8 generation bytes + 1 pad = 9 (same property).
+_GEN_BYTES = 9
 
 
 class RingWorld:
@@ -39,45 +66,111 @@ class RingWorld:
         peers: Optional[Sequence[str]] = None,
         bind_host: str = "0.0.0.0",
         timeout_ms: int = 30000,
+        generation: int = 0,
     ):
         if world < 2:
             raise ValueError("RingWorld needs world >= 2")
         self.engine = engine
         self.rank = rank
         self.world = world
-        peers = list(peers) if peers else ["127.0.0.1"] * world
-        right = (rank + 1) % world
-
-        accepted: List[Optional[QueuePair]] = [None]
-        err: List[Optional[BaseException]] = [None]
-
-        def _accept():
-            try:
-                accepted[0] = engine.listen(
-                    "127.0.0.1" if peers[rank] in ("127.0.0.1", "localhost")
-                    else bind_host,
-                    base_port + rank)
-            except BaseException as e:  # surfaced after join
-                err[0] = e
-
-        t = threading.Thread(target=_accept, daemon=True)
-        t.start()
-        self.right_qp = engine.connect(peers[right], base_port + right,
-                                       timeout_ms)
-        t.join(timeout_ms / 1000)
-        if err[0] is not None:
-            raise err[0]
-        if accepted[0] is None:
-            raise TimeoutError("left neighbor never connected")
-        self.left_qp = accepted[0]
-        self.ring = Ring(engine, self.left_qp, self.right_qp, rank, world)
-        # Schedule-digest buffers (check_schedule), registered lazily.
+        self.base_port = base_port
+        self.peers = list(peers) if peers else ["127.0.0.1"] * world
+        self.bind_host = bind_host
+        self.timeout_ms = timeout_ms
+        # Incarnation number of this ring; monotonic. The bootstrap
+        # exchange adopts the ring maximum, so a restarted rank
+        # (proposing its stale or zero count) catches up with the
+        # survivors' rebuild() bumps.
+        self.generation = int(generation)
+        self.left_qp: Optional[QueuePair] = None
+        self.right_qp: Optional[QueuePair] = None
+        self.ring: Optional[Ring] = None
+        self._barrier_buf = None
+        # Schedule-digest buffers (check_schedule), registered lazily
+        # on the ENGINE (they survive rebuilds; QPs do not).
         self._dg_send = self._dg_recv = None
         self._dg_smr = self._dg_rmr = None
         # Last ring-verified schedule digest: steady-state calls with
         # an unchanged digest skip the exchange entirely.
         self._sched_verified: bytes = b""
-        trace.event("world.up", rank=rank, world=world)
+        self._bootstrap(timeout_ms)
+
+    # ------------------------------------------------------ bootstrap
+
+    def _bootstrap(self, timeout_ms: int) -> None:
+        """Bring up neighbor QPs + ring and agree on the generation.
+        On failure nothing usable is left behind (partial QPs are
+        closed); the Engine stays reusable."""
+        rank, world = self.rank, self.world
+        right = (rank + 1) % world
+        accepted: List[Optional[QueuePair]] = [None]
+        err: List[Optional[BaseException]] = [None]
+
+        def _accept():
+            try:
+                accepted[0] = self.engine.listen(
+                    "127.0.0.1"
+                    if self.peers[rank] in ("127.0.0.1", "localhost")
+                    else self.bind_host,
+                    self.base_port + rank, timeout_ms)
+            except BaseException as e:  # surfaced after join
+                err[0] = e
+
+        t = threading.Thread(target=_accept, daemon=True)
+        t.start()
+        try:
+            self.right_qp = self.engine.connect(
+                self.peers[right], self.base_port + right, timeout_ms)
+        except BaseException:
+            # The accept side is deadline-bounded; reap whatever it
+            # produced so the port is free for the next attempt.
+            t.join(timeout_ms / 1000 + 5)
+            if accepted[0] is not None:
+                accepted[0].close()
+            raise
+        t.join(timeout_ms / 1000 + 5)
+        if err[0] is not None or accepted[0] is None:
+            self.right_qp.close()
+            self.right_qp = None
+            if err[0] is not None:
+                raise err[0]
+            raise TimeoutError("left neighbor never connected")
+        self.left_qp = accepted[0]
+        try:
+            self.ring = Ring(self.engine, self.left_qp, self.right_qp,
+                             rank, world)
+            self._sched_verified = b""
+            self._barrier_buf = None
+            self._ensure_digest_bufs()
+            self._exchange_generation(timeout_ms)
+        except BaseException:
+            self._teardown()
+            raise
+        trace.event("world.up", rank=rank, world=world,
+                    generation=self.generation)
+
+    def _ensure_digest_bufs(self) -> None:
+        if self._dg_smr is not None:
+            return
+        self._dg_send = np.zeros(_DG_BYTES, dtype=np.uint8)
+        self._dg_recv = np.zeros(_DG_BYTES, dtype=np.uint8)
+        self._dg_smr = self.engine.reg_mr(self._dg_send)
+        self._dg_rmr = self.engine.reg_mr(self._dg_recv)
+
+    def _exchange_generation(self, timeout_ms: int) -> None:
+        """Circulate the ring maximum generation (world-1 hops): every
+        rank ends at the same, largest proposal — survivors keep their
+        bumped count, a restarted rank adopts it."""
+        gen = self.generation
+        for _ in range(self.world - 1):
+            self._dg_send[:8] = np.frombuffer(struct.pack("<q", gen),
+                                              dtype=np.uint8)
+            self._dg_hop(_GEN_BYTES, timeout_ms, "generation")
+            left = struct.unpack("<q", self._dg_recv[:8].tobytes())[0]
+            gen = max(gen, left)
+        self.generation = gen
+
+    # ---------------------------------------------------- collectives
 
     def allreduce(self, array, op: int = RED_SUM) -> None:
         """In-place ring allreduce of a C-contiguous numpy array."""
@@ -122,7 +215,7 @@ class RingWorld:
         created and ring-registered once, so steady-state barriers
         post work requests only (the front-loaded-registration
         invariant)."""
-        buf = getattr(self, "_barrier_buf", None)
+        buf = self._barrier_buf
         if buf is None:
             buf = self._barrier_buf = np.zeros(self.world,
                                                dtype=np.int32)
@@ -138,23 +231,35 @@ class RingWorld:
                                wr_id=_WR_DIGEST_RECV)
         self.right_qp.post_send(self._dg_smr, 0, send_len,
                                 wr_id=_WR_DIGEST_SEND)
-        if not self.right_qp.wait(_WR_DIGEST_SEND, timeout_ms=timeout).ok:
-            raise TransportError(f"schedule {what} send failed")
-        if not self.left_qp.wait(_WR_DIGEST_RECV, timeout_ms=timeout).ok:
-            raise TransportError(f"schedule {what} recv failed")
+        wc = self.right_qp.wait(_WR_DIGEST_SEND, timeout_ms=timeout)
+        if not wc.ok:
+            raise TransportError(
+                f"schedule {what} send failed (status {wc.status})")
+        wc = self.left_qp.wait(_WR_DIGEST_RECV, timeout_ms=timeout)
+        if not wc.ok:
+            raise TransportError(
+                f"schedule {what} recv failed (status {wc.status})")
 
     def check_schedule(self, digest: bytes, describe: str = "") -> None:
         """Fail fast on SPMD schedule divergence.
 
-        Round 1: each rank sends its 32-byte schedule digest to its
-        right neighbor and compares the one received from its left —
-        on a CLOSED ring, every pair matching implies all ranks match.
-        Round 2: a status byte (1 = my pair matched) circulates
-        world-1 hops carrying the ring-wide minimum, so EVERY rank —
-        not just the divergent pair — raises immediately instead of
-        posting into a dead collective and stalling out the ~30 s ring
-        timeout (the failure mode the reference world debugged from
-        dmesg).
+        Round 1: each rank sends its 32-byte schedule digest — plus
+        the ring GENERATION it believes it is in — to its right
+        neighbor and compares the pair received from its left; on a
+        CLOSED ring, every pair matching implies all ranks match.
+        Round 2: a status byte (2 = my pair matched, 1 = stale
+        generation, 0 = digest mismatch) circulates world-1 hops
+        carrying the ring-wide minimum, so EVERY rank — not just the
+        divergent pair — raises immediately, and with the right error
+        class, instead of posting into a dead collective and stalling
+        out the ~30 s ring timeout (the failure mode the reference
+        world debugged from dmesg).
+
+        **Generation fencing**: a rank still on a previous incarnation
+        (it missed a ``rebuild()``) fails the comparison with an
+        explicit stale-generation error — its packets are fenced off
+        at the first collective instead of desynchronizing the new
+        ring. The error is retryable: rebuilding re-syncs generations.
 
         TDR_NO_SCHED_CHECK=1 skips only the comparison/raise; the
         messages are still exchanged on every rank so a per-rank env
@@ -171,7 +276,9 @@ class RingWorld:
         call exchanges on every rank regardless of
         TDR_NO_SCHED_CHECK). A rank whose schedule CHANGES re-runs
         the exchange; if all ranks changed identically it verifies
-        and re-caches, and if they diverged it fails fast here. The
+        and re-caches, and if they diverged it fails fast here. A
+        rebuild resets the cache, so the first collective of every
+        incarnation re-verifies under the new generation. The
         residual (unchecked) case is a schedule change on a strict
         subset of ranks against a previously-verified steady state —
         that desynchronizes the ring and surfaces as a completion
@@ -182,40 +289,54 @@ class RingWorld:
         if digest == self._sched_verified:
             trace.event("world.sched_cached")
             return
-        if self._dg_smr is None:
-            # 33 bytes, deliberately indivisible by every ring dtype
-            # size: if steady-state skew ever mismatches a digest frame
-            # against a posted reduce-recv (a subset-of-ranks schedule
-            # change), the fold VALIDATION rejects it — the frame can
-            # error a step but can never be silently summed into a
-            # live gradient buffer.
-            self._dg_send = np.zeros(33, dtype=np.uint8)
-            self._dg_recv = np.zeros(33, dtype=np.uint8)
-            self._dg_smr = self.engine.reg_mr(self._dg_send)
-            self._dg_rmr = self.engine.reg_mr(self._dg_recv)
+        self._ensure_digest_bufs()
         assert len(digest) == 32
         timeout = int(os.environ.get("TDR_RING_TIMEOUT_MS", "30000"))
         check = os.environ.get("TDR_NO_SCHED_CHECK", "0") in ("", "0")
 
-        trace.event("world.sched_check")
+        trace.event("world.sched_check", generation=self.generation)
         self._dg_recv[:] = 0
         self._dg_send[:32] = np.frombuffer(digest, dtype=np.uint8)
-        self._dg_hop(33, timeout, "digest")
+        self._dg_send[32:40] = np.frombuffer(
+            struct.pack("<q", self.generation), dtype=np.uint8)
+        self._dg_hop(_DG_BYTES, timeout, "digest")
         got = self._dg_recv[:32].tobytes()
-        ok = got == digest
+        got_gen = struct.unpack("<q", self._dg_recv[32:40].tobytes())[0]
+        ok_gen = got_gen == self.generation
+        ok_digest = got == digest
 
-        status = 1 if (ok or not check) else 0
+        # Status circulation: 2 = pair matched, 1 = stale generation,
+        # 0 = digest mismatch; world-1 hops carry the ring-wide
+        # MINIMUM, so the most severe verdict reaches EVERY rank and
+        # each raises the right error CLASS — generation skew is
+        # retryable (a rebuild re-syncs it), layout divergence is
+        # fatal — not just the ranks adjacent to the divergence.
+        if not check or (ok_gen and ok_digest):
+            status = 2
+        elif not ok_gen:
+            status = 1
+        else:
+            status = 0
         for _ in range(self.world - 1):
             self._dg_send[0] = status
             self._dg_hop(1, timeout, "status")
             status = min(status, int(self._dg_recv[0]))
-        if status == 1:
+        if status == 2:
             # Ring-wide agreement on this digest (or on skipping the
             # comparison): steady-state repeats can skip the exchange.
             self._sched_verified = digest
         if not check:
             return
-        if not ok:
+        if not ok_gen or status == 1:
+            detail = (f"left neighbor is at incarnation {got_gen}, "
+                      f"local ring is at {self.generation}" if not ok_gen
+                      else "reported by a peer (this rank's own pair "
+                      "matched)")
+            raise TransportError(
+                f"stale ring generation on rank {self.rank}: {detail} "
+                "— traffic from a previous incarnation is fenced off; "
+                "rebuild() every rank", retryable=True)
+        if not ok_digest:
             raise TransportError(
                 f"SPMD schedule mismatch on rank {self.rank}: left "
                 f"neighbor's collective layout digest {got.hex()[:16]}… "
@@ -228,14 +349,83 @@ class RingWorld:
                 f"{self.rank}'s own pair matched); aborting the "
                 "collective before posting. Local layout: " + describe)
 
+    # ------------------------------------------------------ elasticity
+
+    def _teardown(self) -> None:
+        """Best-effort release of the ring and neighbor QPs — never
+        raises, leaves the Engine reusable, and keeps the digest MRs
+        (engine-scoped) for the next incarnation. Closing the QPs
+        flushes everything the peers posted against us, so a wedged
+        neighbor unblocks promptly instead of riding out the stall
+        deadline."""
+        ring, self.ring = self.ring, None
+        left, self.left_qp = self.left_qp, None
+        right, self.right_qp = self.right_qp, None
+        for closer in (ring and ring.destroy, left and left.close,
+                       right and right.close):
+            if closer is None:
+                continue
+            try:
+                closer()
+            except Exception:
+                pass
+        self._sched_verified = b""
+        self._barrier_buf = None
+
+    def rebuild(self, max_attempts: int = 6, backoff_s: float = 0.2,
+                backoff_cap_s: float = 5.0, jitter: float = 0.25,
+                timeout_ms: Optional[int] = None) -> "RingWorld":
+        """Tear down this incarnation and re-rendezvous under the next
+        generation: exponential backoff with jitter between attempts,
+        a bounded retry budget, and a per-attempt accept/connect
+        deadline. All ranks of the new incarnation must converge on a
+        rebuild (survivors call this; a restarted rank constructs a
+        fresh ``RingWorld`` at the same ports and adopts the bumped
+        generation at bootstrap). Raises a non-retryable
+        ``TransportError`` when the budget is exhausted."""
+        timeout = int(self.timeout_ms if timeout_ms is None else timeout_ms)
+        note_fault_injections()
+        self._teardown()
+        self.generation += 1
+        trace.event("world.rebuild", rank=self.rank, phase="begin",
+                    generation=self.generation)
+        # Deterministic per-(rank, generation) jitter: desynchronizes
+        # ranks' retry storms without making test runs flaky.
+        rng = random.Random((self.rank << 20) ^ self.generation)
+        delay = float(backoff_s)
+        last: Optional[BaseException] = None
+        for attempt in range(1, max_attempts + 1):
+            try:
+                self._bootstrap(timeout)
+                note_fault_injections()
+                trace.event("world.rebuild", rank=self.rank, phase="ok",
+                            generation=self.generation, attempts=attempt)
+                return self
+            except (TransportError, TimeoutError, OSError) as e:
+                last = e
+                self._teardown()
+                if attempt == max_attempts:
+                    break
+                sleep_s = delay * (1.0 + jitter * rng.random())
+                trace.event("world.rebuild", rank=self.rank, phase="retry",
+                            generation=self.generation, attempts=attempt,
+                            sleep_s=round(sleep_s, 3))
+                time.sleep(sleep_s)
+                delay = min(delay * 2.0, backoff_cap_s)
+        raise TransportError(
+            f"world rebuild failed after {max_attempts} attempts (rank "
+            f"{self.rank}, generation {self.generation}): {last}",
+            retryable=False)
+
     def close(self) -> None:
-        self.ring.destroy()
+        self._teardown()
         for mr in (self._dg_smr, self._dg_rmr):
             if mr is not None:
-                mr.deregister()
+                try:
+                    mr.deregister()
+                except Exception:
+                    pass
         self._dg_smr = self._dg_rmr = None
-        self.left_qp.close()
-        self.right_qp.close()
 
     def __enter__(self):
         return self

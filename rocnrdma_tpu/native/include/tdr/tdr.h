@@ -84,6 +84,31 @@ size_t tdr_copy_pool_workers(void);
  * traffic (bench/diagnostics). */
 void tdr_copy_counters(uint64_t *nt_bytes, uint64_t *plain_bytes);
 
+/* ------------------------------------------------------------------ *
+ * Deterministic fault injection — the TDR_FAULT_PLAN registry.
+ *
+ * TDR_FAULT_PLAN holds comma-separated clauses of the form
+ * site[:match...]:action (grammar in README.md "Failure semantics"),
+ * e.g. "send:chunk=3:once=general_err,conn:drop_after=2". Sites:
+ * send (emu SEND-class posts: the WR completes with the injected
+ * status instead of transmitting), conn (emu posts: the QP's socket
+ * drops after N posts), land (the landing-time window; generalizes
+ * TDR_FAULT_LANDING_DELAY_MS), ring (tdr_ring_allreduce entry: the
+ * collective call fails before posting). Status actions are valid at
+ * send/ring only, drop_after at conn only, stall_ms anywhere;
+ * clauses whose action the site cannot apply are rejected at parse
+ * time so a hit counter never reports an injection that did not
+ * happen.
+ *
+ * Per-clause hit counters are exported so tests assert the fault
+ * ACTUALLY fired — a green test whose fault never armed is a lie.
+ * Counters are process-wide; reset re-parses the environment.
+ * ------------------------------------------------------------------ */
+int tdr_fault_plan_clauses(void);
+uint64_t tdr_fault_plan_hits(int idx);  /* times clause idx fired   */
+uint64_t tdr_fault_plan_seen(int idx);  /* site arrivals it matched */
+void tdr_fault_plan_reset(void);
+
 /* spec: "emu", "verbs", "verbs:<device>", or "auto" (verbs, else emu). */
 tdr_engine *tdr_engine_open(const char *spec);
 void tdr_engine_close(tdr_engine *e);
@@ -114,8 +139,13 @@ int tdr_mr_invalidate(tdr_mr *mr);
 int tdr_mr_cpu_foldable(const tdr_mr *mr);
 
 /* Connection bring-up over an out-of-band TCP rendezvous (the role
- * perftest's TCP port plays). Blocking; one QP per call. */
+ * perftest's TCP port plays). Blocking; one QP per call.
+ * tdr_listen_timeout bounds the accept wait (-1 = forever) so an
+ * elastic rendezvous whose peer never arrives returns instead of
+ * stranding a thread in accept on the port the next attempt needs. */
 tdr_qp *tdr_listen(tdr_engine *e, const char *bind_host, int port);
+tdr_qp *tdr_listen_timeout(tdr_engine *e, const char *bind_host, int port,
+                           int timeout_ms);
 tdr_qp *tdr_connect(tdr_engine *e, const char *host, int port,
                     int timeout_ms);
 int tdr_qp_close(tdr_qp *qp);

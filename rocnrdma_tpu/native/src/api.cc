@@ -20,6 +20,22 @@ void tdr_copy_counters(uint64_t *nt_bytes, uint64_t *plain_bytes) {
   tdr::copy_counters(nt_bytes, plain_bytes);
 }
 
+/* Fault-plan introspection (fault.cc): per-clause hit counters so a
+ * test can assert the injected fault actually fired. */
+int tdr_fault_plan_clauses(void) {
+  return static_cast<int>(tdr::fault_clause_count());
+}
+
+uint64_t tdr_fault_plan_hits(int idx) {
+  return idx < 0 ? 0 : tdr::fault_clause_hits(static_cast<size_t>(idx));
+}
+
+uint64_t tdr_fault_plan_seen(int idx) {
+  return idx < 0 ? 0 : tdr::fault_clause_seen(static_cast<size_t>(idx));
+}
+
+void tdr_fault_plan_reset(void) { tdr::fault_plan_reset(); }
+
 tdr_engine *tdr_engine_open(const char *spec) {
   std::string s = spec ? spec : "auto";
   std::string err;
@@ -90,7 +106,13 @@ int tdr_mr_cpu_foldable(const tdr_mr *mr) {
 
 tdr_qp *tdr_listen(tdr_engine *e, const char *bind_host, int port) {
   return reinterpret_cast<tdr_qp *>(
-      reinterpret_cast<Engine *>(e)->listen(bind_host, port));
+      reinterpret_cast<Engine *>(e)->listen(bind_host, port, -1));
+}
+
+tdr_qp *tdr_listen_timeout(tdr_engine *e, const char *bind_host, int port,
+                           int timeout_ms) {
+  return reinterpret_cast<tdr_qp *>(
+      reinterpret_cast<Engine *>(e)->listen(bind_host, port, timeout_ms));
 }
 
 tdr_qp *tdr_connect(tdr_engine *e, const char *host, int port,

@@ -92,7 +92,10 @@ class Engine {
   virtual Mr *reg_dmabuf_mr(int fd, size_t offset, size_t len, uint64_t iova,
                             int access) = 0;
   virtual int dereg_mr(Mr *mr) = 0;
-  virtual Qp *listen(const char *bind_host, int port) = 0;
+  // timeout_ms bounds the accept wait (-1 = forever): elastic callers
+  // (RingWorld.rebuild) must never leak a thread blocked in accept on
+  // a port the next rendezvous attempt needs.
+  virtual Qp *listen(const char *bind_host, int port, int timeout_ms) = 0;
   virtual Qp *connect(const char *host, int port, int timeout_ms) = 0;
 };
 
@@ -127,6 +130,23 @@ bool env_set(const char *name);
 // default 30s) — shared so the engines' quiesce backstops cannot
 // undercut the deadline they are meant to exceed.
 int ring_timeout_ms();
+
+// Deterministic fault injection (fault.cc): the TDR_FAULT_PLAN
+// registry. fault_point(site, chunk) evaluates every clause for the
+// named site and returns the TDR_WC_* status to inject (>= 0),
+// TDR_FAULT_DROP to kill the connection, or TDR_FAULT_NONE; stall_ms
+// clauses sleep inline before returning. Counters are process-wide.
+constexpr int TDR_FAULT_NONE = -1;
+constexpr int TDR_FAULT_DROP = -2;
+int fault_point(const char *site, long long chunk = -1);
+// The landing-window hook: honors the legacy TDR_FAULT_LANDING_DELAY_MS
+// knob, then the plan's "land" site.
+void fault_land_delay();
+size_t fault_clause_count();
+uint64_t fault_clause_hits(size_t idx);
+uint64_t fault_clause_seen(size_t idx);
+// Re-parse TDR_FAULT_PLAN from the environment, zeroing all counters.
+void fault_plan_reset();
 
 // Element size for a TDR_DT_*; 0 for unknown.
 size_t dtype_size(int dt);
@@ -176,7 +196,9 @@ bool par_cma_reduce2(pid_t pid, void *dst, uint64_t src, size_t bytes,
                      int dt, int op);
 
 // TCP helpers (bootstrap for both backends; data path for emu).
-int tcp_listen_accept(const char *bind_host, int port, std::string *err);
+// timeout_ms bounds the accept wait (-1 = forever).
+int tcp_listen_accept(const char *bind_host, int port, std::string *err,
+                      int timeout_ms = -1);
 int tcp_connect_retry(const char *host, int port, int timeout_ms,
                       std::string *err);
 bool read_full(int fd, void *buf, size_t len);

@@ -151,18 +151,15 @@ std::string read_boot_id() {
 
 bool cma_disabled() { return env_set("TDR_NO_CMA"); }
 
-// Fault injection (tests): widen the window between an inbound
-// message matching a posted recv and the landing-time MR
-// re-validation to a deterministic size, so the free-while-landing
-// interleaving (amdp2p.c:88-109 — the subtlest behavior the
-// reference exists to handle) can be forced rather than raced for.
-void fault_landing_delay() {
-  const char *env = getenv("TDR_FAULT_LANDING_DELAY_MS");
-  if (env && *env) {
-    int ms = atoi(env);
-    if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
-  }
-}
+// Fault injection (tests): the landing-time hook widens the window
+// between an inbound message matching a posted recv and the
+// landing-time MR re-validation to a deterministic size, so the
+// free-while-landing interleaving (amdp2p.c:88-109 — the subtlest
+// behavior the reference exists to handle) can be forced rather than
+// raced for. It and the post-path hooks below are driven by the
+// TDR_FAULT_PLAN registry (fault.cc); the legacy
+// TDR_FAULT_LANDING_DELAY_MS knob still works through it.
+void fault_landing_delay() { fault_land_delay(); }
 
 // Payload-size sanity cap for wire-controlled allocations (bounced
 // unexpected messages, foldback buffers): a corrupt peer must not be
@@ -403,7 +400,7 @@ class EmuEngine : public Engine {
     return base + loff;
   }
 
-  Qp *listen(const char *bind_host, int port) override;
+  Qp *listen(const char *bind_host, int port, int timeout_ms) override;
   Qp *connect(const char *host, int port, int timeout_ms) override;
 
  private:
@@ -490,8 +487,28 @@ class EmuQp : public Qp {
     if (progress_.joinable()) progress_.join();
   }
 
+  // Fault-plan hook shared by every post path: a conn-drop clause
+  // shuts this QP's socket down (the post then flushes, and the peer
+  // sees RC connection loss); a send-site clause completes the WR
+  // with the injected status instead of transmitting. Returns true
+  // when the WR was consumed by an injection.
+  bool fault_post(const char *site, int opcode, uint64_t wr_id) {
+    if (fault_point("conn") == TDR_FAULT_DROP)
+      ::shutdown(fd_, SHUT_RDWR);
+    if (site) {
+      int f = fault_point(site,
+                          static_cast<long long>(wr_id & 0xffffffffffffull));
+      if (f >= 0) {
+        push_wc({wr_id, f, opcode, 0});
+        return true;
+      }
+    }
+    return false;
+  }
+
   int post_write(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                  size_t len, uint64_t wr_id) override {
+    fault_post(nullptr, TDR_OP_WRITE, wr_id);
     char *src = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
     if (!src) {
@@ -519,6 +536,7 @@ class EmuQp : public Qp {
 
   int post_read(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                 size_t len, uint64_t wr_id) override {
+    fault_post(nullptr, TDR_OP_READ, wr_id);
     char *dst = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
     if (!dst) {
@@ -544,6 +562,7 @@ class EmuQp : public Qp {
   }
 
   int post_send(Mr *lmr, size_t loff, size_t len, uint64_t wr_id) override {
+    if (fault_post("send", TDR_OP_SEND, wr_id)) return 0;
     char *src = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
     if (!src) {
@@ -584,6 +603,7 @@ class EmuQp : public Qp {
       set_error("post_send_foldback: not negotiated with peer");
       return -1;
     }
+    if (fault_post("send", TDR_OP_SEND, wr_id)) return 0;
     char *src = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
     if (!src) {
@@ -1322,9 +1342,9 @@ class EmuQp : public Qp {
   bool dead_ = false;
 };
 
-Qp *EmuEngine::listen(const char *bind_host, int port) {
+Qp *EmuEngine::listen(const char *bind_host, int port, int timeout_ms) {
   std::string err;
-  int fd = tcp_listen_accept(bind_host, port, &err);
+  int fd = tcp_listen_accept(bind_host, port, &err, timeout_ms);
   if (fd < 0) {
     set_error("listen: " + err);
     return nullptr;

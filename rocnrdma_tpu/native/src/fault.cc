@@ -1,0 +1,261 @@
+// Deterministic fault injection: the TDR_FAULT_PLAN registry.
+//
+// The one-shot TDR_FAULT_LANDING_DELAY_MS hook proved the emulation
+// can force the reference's subtlest interleaving instead of racing
+// for it; this generalizes that into a parseable plan that injects
+// transient WR failures, connection drops, and stalls at NAMED points,
+// with per-clause hit counters exported through the C API so tests can
+// assert the fault actually fired (never "the test passed because the
+// fault silently failed to arm").
+//
+// Grammar (documented for users in README.md "Failure semantics"):
+//
+//   TDR_FAULT_PLAN := clause[,clause...]
+//   clause         := site[:match...]:action
+//   site           := send | conn | land | ring
+//   match          := chunk=K     (send: ring chunk index — the low
+//                                  48 bits of the wr_id)
+//                     nth=N       (fire on the Nth matching arrival at
+//                                  the site, 1-based, process-wide)
+//   action         := once=STATUS   (send/ring only: inject STATUS
+//                                    once, then disarm)
+//                     always=STATUS (send/ring only: inject on every
+//                                    match)
+//                     stall_ms=MS   (any site: sleep MS at the site)
+//                     drop_after=N  (conn only: the first N posts go
+//                                    through, the next one finds the
+//                                    connection dead)
+//   Clauses whose action the site cannot apply are rejected at parse
+//   time (a counted-but-unapplied injection would be a lie).
+//   STATUS         := general_err | rem_access_err | loc_access_err |
+//                     flush_err
+//
+// Sites:
+//   send — emu post_send / post_send_foldback, before any wire work:
+//          an injected status completes the WR with that error instead
+//          of transmitting (the transient-WR-failure model).
+//   conn — every emu post (write/read/send/foldback): when a
+//          drop_after clause fires, the QP's socket is shut down and
+//          the post flushes — RC connection loss, deterministically.
+//   land — the landing-time window in the emu progress engine (the
+//          generalization of TDR_FAULT_LANDING_DELAY_MS, which is
+//          still honored).
+//   ring — entry of tdr_ring_allreduce: an injected status fails the
+//          collective call before any posting (a transient collective
+//          fault the elastic layer must recover from).
+//
+// Counters are PROCESS-WIDE (all engines/QPs share the registry), so
+// nth=N is deterministic under single-threaded posting and
+// deterministic-at-collective-granularity when ranks share a process.
+// The plan is parsed once, lazily; tdr_fault_plan_reset() re-reads the
+// environment (tests set the plan, then reset).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <atomic>
+#include <chrono>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace tdr {
+namespace {
+
+struct FaultClause {
+  std::string spec;  // original text, for diagnostics
+  std::string site;
+  long long chunk = -1;       // match: wr_id low 48 bits
+  long long nth = -1;         // match: Nth arrival (1-based)
+  long long drop_after = -1;  // conn: posts that survive
+  long long stall_ms = 0;
+  bool once = false;
+  int status = -1;  // TDR_WC_* to inject
+  // Runtime state (guarded by g_mu).
+  uint64_t seen = 0;
+  uint64_t hits = 0;
+  bool spent = false;
+};
+
+std::mutex g_mu;                  // guards g_clauses and their counters
+std::vector<FaultClause> g_clauses;
+bool g_parsed = false;
+std::atomic<bool> g_init{false};  // fast-path gate: plan parsed at all
+std::atomic<bool> g_active{false};
+
+int status_by_name(const std::string &name) {
+  if (name == "general_err") return TDR_WC_GENERAL_ERR;
+  if (name == "rem_access_err") return TDR_WC_REM_ACCESS_ERR;
+  if (name == "loc_access_err") return TDR_WC_LOC_ACCESS_ERR;
+  if (name == "flush_err") return TDR_WC_FLUSH_ERR;
+  return -1;
+}
+
+bool parse_ll(const std::string &v, long long *out) {
+  if (v.empty()) return false;
+  char *end = nullptr;
+  long long r = strtoll(v.c_str(), &end, 10);
+  if (!end || *end) return false;
+  *out = r;
+  return true;
+}
+
+// One clause: site[:k=v...]. Returns false (and warns) on bad specs so
+// a typo'd plan is loud, not a silently green test.
+bool parse_clause(const std::string &text, FaultClause *c) {
+  c->spec = text;
+  size_t pos = 0;
+  bool first = true;
+  while (pos <= text.size()) {
+    size_t colon = text.find(':', pos);
+    std::string tok = text.substr(
+        pos, colon == std::string::npos ? std::string::npos : colon - pos);
+    pos = colon == std::string::npos ? text.size() + 1 : colon + 1;
+    if (tok.empty()) continue;
+    if (first) {
+      first = false;
+      if (tok != "send" && tok != "conn" && tok != "land" && tok != "ring")
+        return false;
+      c->site = tok;
+      continue;
+    }
+    size_t eq = tok.find('=');
+    if (eq == std::string::npos) return false;
+    std::string key = tok.substr(0, eq), val = tok.substr(eq + 1);
+    if (key == "chunk") {
+      if (!parse_ll(val, &c->chunk) || c->chunk < 0) return false;
+    } else if (key == "nth") {
+      if (!parse_ll(val, &c->nth) || c->nth < 1) return false;
+    } else if (key == "drop_after") {
+      if (!parse_ll(val, &c->drop_after) || c->drop_after < 0) return false;
+    } else if (key == "stall_ms") {
+      if (!parse_ll(val, &c->stall_ms) || c->stall_ms < 0) return false;
+    } else if (key == "once" || key == "always") {
+      c->status = status_by_name(val);
+      if (c->status < 0) return false;
+      c->once = (key == "once");
+    } else {
+      return false;
+    }
+  }
+  // Per-site capability validation: a clause whose action the site
+  // cannot apply must be REJECTED at parse time — otherwise its hit
+  // counter would report an injection that never happened (the exact
+  // lie the counters exist to prevent). Status injections exist at
+  // send (WR completion) and ring (collective entry); conn drops
+  // connections; land (and every site) can stall.
+  if (c->status >= 0 && c->site != "send" && c->site != "ring")
+    return false;
+  if (c->drop_after >= 0 && c->site != "conn") return false;
+  // A clause must DO something.
+  return c->status >= 0 || c->stall_ms > 0 || c->drop_after >= 0;
+}
+
+void parse_locked() {
+  g_clauses.clear();
+  g_parsed = true;
+  const char *env = getenv("TDR_FAULT_PLAN");
+  if (env && *env) {
+    std::string plan(env);
+    size_t pos = 0;
+    while (pos <= plan.size()) {
+      size_t comma = plan.find(',', pos);
+      std::string text = plan.substr(
+          pos, comma == std::string::npos ? std::string::npos : comma - pos);
+      pos = comma == std::string::npos ? plan.size() + 1 : comma + 1;
+      if (text.empty()) continue;
+      FaultClause c;
+      if (parse_clause(text, &c)) {
+        g_clauses.push_back(std::move(c));
+      } else {
+        fprintf(stderr, "tdr: ignoring bad TDR_FAULT_PLAN clause '%s'\n",
+                text.c_str());
+      }
+    }
+  }
+  g_active.store(!g_clauses.empty(), std::memory_order_release);
+}
+
+void ensure_parsed() {
+  if (g_init.load(std::memory_order_acquire)) return;
+  std::lock_guard<std::mutex> g(g_mu);
+  if (!g_parsed) parse_locked();
+  g_init.store(true, std::memory_order_release);
+}
+
+}  // namespace
+
+int fault_point(const char *site, long long chunk) {
+  ensure_parsed();
+  if (!g_active.load(std::memory_order_acquire)) return TDR_FAULT_NONE;
+  long long stall = 0;
+  int inject = TDR_FAULT_NONE;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    for (auto &c : g_clauses) {
+      if (c.site != site) continue;
+      if (c.chunk >= 0 && chunk != c.chunk) continue;
+      c.seen++;
+      if (c.nth >= 1 && static_cast<long long>(c.seen) != c.nth) continue;
+      if (c.drop_after >= 0) {
+        // The first drop_after arrivals pass; the next one drops the
+        // connection (fires once — the dead socket handles the rest).
+        if (static_cast<long long>(c.seen) <= c.drop_after || c.spent)
+          continue;
+        c.spent = true;
+        c.hits++;
+        if (inject == TDR_FAULT_NONE) inject = TDR_FAULT_DROP;
+        continue;
+      }
+      if (c.once && c.spent) continue;
+      if (c.once) c.spent = true;
+      c.hits++;
+      stall += c.stall_ms;
+      if (c.status >= 0 && inject == TDR_FAULT_NONE) inject = c.status;
+    }
+  }
+  if (stall > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(stall));
+  return inject;
+}
+
+void fault_land_delay() {
+  // Legacy one-shot knob, kept working: the free-while-landing window
+  // widener the fault plan generalizes.
+  const char *env = getenv("TDR_FAULT_LANDING_DELAY_MS");
+  if (env && *env) {
+    int ms = atoi(env);
+    if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+  }
+  fault_point("land");
+}
+
+size_t fault_clause_count() {
+  ensure_parsed();
+  std::lock_guard<std::mutex> g(g_mu);
+  return g_clauses.size();
+}
+
+uint64_t fault_clause_hits(size_t idx) {
+  ensure_parsed();
+  std::lock_guard<std::mutex> g(g_mu);
+  return idx < g_clauses.size() ? g_clauses[idx].hits : 0;
+}
+
+uint64_t fault_clause_seen(size_t idx) {
+  ensure_parsed();
+  std::lock_guard<std::mutex> g(g_mu);
+  return idx < g_clauses.size() ? g_clauses[idx].seen : 0;
+}
+
+void fault_plan_reset() {
+  std::lock_guard<std::mutex> g(g_mu);
+  parse_locked();
+  g_init.store(true, std::memory_order_release);
+}
+
+}  // namespace tdr

@@ -743,6 +743,18 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
     return -1;
   }
   if (count == 0) return 0;
+  // Fault-plan site "ring" (TDR_FAULT_PLAN, fault.cc): a transient
+  // collective failure injected BEFORE any posting — the recovery
+  // layer's deterministic trigger. The caller sees the same shape of
+  // error a mid-step peer loss produces (retryable, nothing posted).
+  {
+    int f = tdr::fault_point("ring");
+    if (f >= 0) {
+      tdr::set_error("ring: fault injected (completion error status " +
+                     std::to_string(f) + ")");
+      return -1;
+    }
+  }
   std::lock_guard<std::mutex> g(r->mu);
   const int world = r->world;
   const size_t nbytes = count * esz;

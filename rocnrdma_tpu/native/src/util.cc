@@ -3,6 +3,7 @@
 #include <errno.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
@@ -263,7 +264,8 @@ static bool make_addr(const char *host, int port, sockaddr_in *out,
   return true;
 }
 
-int tcp_listen_accept(const char *bind_host, int port, std::string *err) {
+int tcp_listen_accept(const char *bind_host, int port, std::string *err,
+                      int timeout_ms) {
   sockaddr_in addr;
   if (!make_addr(bind_host, port, &addr, err)) return -1;
   int lfd = socket(AF_INET, SOCK_STREAM, 0);
@@ -278,6 +280,31 @@ int tcp_listen_accept(const char *bind_host, int port, std::string *err) {
     if (err) *err = std::string("bind/listen: ") + strerror(errno);
     close(lfd);
     return -1;
+  }
+  if (timeout_ms >= 0) {
+    // Bounded accept: a rendezvous whose peer never arrives must
+    // return (releasing the port for the next attempt), not strand a
+    // thread in accept holding the listener open.
+    pollfd pfd{lfd, POLLIN, 0};
+    int pr;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    for (;;) {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      deadline - std::chrono::steady_clock::now())
+                      .count();
+      if (left < 0) left = 0;
+      pr = poll(&pfd, 1, static_cast<int>(left));
+      if (pr < 0 && errno == EINTR) continue;
+      break;
+    }
+    if (pr <= 0) {
+      if (err)
+        *err = pr == 0 ? ("accept timeout on port " + std::to_string(port))
+                       : (std::string("poll: ") + strerror(errno));
+      close(lfd);
+      return -1;
+    }
   }
   int fd = accept(lfd, nullptr, nullptr);
   int saved = errno;

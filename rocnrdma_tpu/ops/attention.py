@@ -34,6 +34,11 @@ from jax.sharding import PartitionSpec as P
 from rocnrdma_tpu.ops import sharding as _sharding
 from rocnrdma_tpu.ops.common import trace_time_knob
 
+# jax < 0.5 spells it TPUCompilerParams; alias so one source runs on
+# both (this CI image ships 0.4.x, TPU hosts may run newer).
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
@@ -225,7 +230,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool, block_q: int,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
@@ -426,7 +431,7 @@ def _flash_backward(q, k, v, out, lse, do, scale: float, causal: bool,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
@@ -459,7 +464,7 @@ def _flash_backward(q, k, v, out, lse, do, scale: float, causal: bool,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),

@@ -24,6 +24,11 @@ from jax.sharding import PartitionSpec as P
 from rocnrdma_tpu.ops import sharding as _sharding
 from rocnrdma_tpu.ops.common import trace_time_knob
 
+# jax < 0.5 spells it TPUCompilerParams; alias so one source runs on
+# both (this CI image ships 0.4.x, TPU hosts may run newer).
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 _BLOCK_ROWS = 256
 
 
@@ -186,7 +191,7 @@ def _rmsnorm_bwd_pallas(x2d, w, g2d, eps: float, interpret: bool,
                    pl.BlockSpec((1, d), lambda i: (0, 0),
                                 memory_space=pltpu.VMEM)),
         scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

@@ -70,5 +70,15 @@ def run_sharded(local_fn, args, specs_fn, fits_fn, fallback_fn):
     if not fits_fn(mesh, ba, ha):
         return fallback_fn(*args)
     in_specs, out_specs = specs_fn(ba, ha)
-    return jax.shard_map(local_fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)(*args)
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        # jax < 0.5: shard_map still lives in jax.experimental.
+        from jax.experimental.shard_map import shard_map
+    try:
+        wrapped = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=False)
+    except TypeError:
+        # jax < 0.5 spells the checker flag check_rep.
+        wrapped = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_rep=False)
+    return wrapped(*args)

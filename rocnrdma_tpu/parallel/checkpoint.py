@@ -22,6 +22,12 @@ from rocnrdma_tpu.utils.trace import trace
 _FORMAT_VERSION = 1
 
 
+def checkpoint_file(path: str) -> str:
+    """The on-disk file a checkpoint ``path`` resolves to — the one
+    normalization save/restore/existence checks must share."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def _flatten_with_paths(tree) -> List[Tuple[str, Any]]:
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     out = []
@@ -71,8 +77,7 @@ def save_checkpoint(path: str, trainer, step: int) -> None:
     arrays["__meta__/config"] = np.frombuffer(
         trainer.cfg.name.encode(), dtype=np.uint8)
     arrays["__meta__/version"] = np.asarray(_FORMAT_VERSION)
-    if not path.endswith(".npz"):
-        path = path + ".npz"
+    path = checkpoint_file(path)
     tmp = path + ".tmp.npz"
     np.savez(tmp, **arrays)
     os.replace(tmp, path)  # atomic publish — no torn checkpoints
@@ -81,8 +86,7 @@ def save_checkpoint(path: str, trainer, step: int) -> None:
 
 def restore_checkpoint(path: str, trainer) -> int:
     """Restore in place onto the trainer's shardings; returns step."""
-    if not path.endswith(".npz"):
-        path = path + ".npz"
+    path = checkpoint_file(path)
     with np.load(path) as z:
         cfg_name = bytes(z["__meta__/config"]).decode()
         if cfg_name != trainer.cfg.name:
@@ -112,5 +116,10 @@ def restore_checkpoint(path: str, trainer) -> int:
 
         trainer.params = rebuild("params", trainer.params)
         trainer.opt_state = rebuild("opt", trainer.opt_state)
+    if hasattr(trainer, "global_step"):
+        # Keep the trainer's step counter (the elastic policy's
+        # checkpoint cadence and resume point) in sync with the
+        # restored state.
+        trainer.global_step = step
     trace.event("ckpt.restore", path=path, step=step)
     return step

@@ -19,6 +19,8 @@ whole step is one fused jit.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
+import os
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -40,6 +42,39 @@ def loss_fn(model: Llama, params, tokens) -> jnp.ndarray:
     return cross_entropy_loss(logits, tokens[:, 1:])
 
 
+@dataclasses.dataclass
+class ElasticPolicy:
+    """Opt-in auto-resume for transient transport failures.
+
+    When a ``step()`` raises a RETRYABLE ``TransportError`` (peer
+    death, connection drop, stall — the taxonomy lives on the
+    exception), the trainer drops the transport world, rebuilds it
+    (``RingWorld.rebuild``: re-rendezvous with backoff under a new
+    generation), restores params/optimizer/step from the last
+    checkpoint, and re-runs the step — so a SIGKILLed-and-restarted
+    rank rejoins and training converges to the same params as an
+    uninterrupted run. Fatal errors (access violations, schedule
+    mismatches) re-raise unchanged.
+
+    ``checkpoint_path``: where this rank saves/restores its state
+    (each rank uses its own path; DP keeps ranks in lockstep, so the
+    contents agree). ``save_every``: checkpoint cadence in steps.
+    With 1 (the default) the failed step re-runs exactly in place;
+    with larger values a mid-interval failure restores a checkpoint
+    OLDER than the current step, and since ``step()`` cannot replay
+    the caller's intervening batches it raises instead of silently
+    desynchronizing — the caller must then drive its data loop from
+    ``trainer.global_step``. ``max_resumes``: resume budget PER STEP
+    before the error propagates. ``rebuild``: kwargs forwarded to
+    ``RingWorld.rebuild`` (retry budget, backoff, per-attempt
+    deadline)."""
+
+    checkpoint_path: str
+    save_every: int = 1
+    max_resumes: int = 4
+    rebuild: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
 class Trainer:
     def __new__(cls, *args, **kwargs):
         # Front door for sequence parallelism: Trainer(cfg,
@@ -59,7 +94,8 @@ class Trainer:
                 raise ValueError(
                     "mesh_shape does not apply to the seq_parallel "
                     "trainer (one device per ring rank)")
-            for unsupported in ("mesh_shape", "devices", "cross_slice_sync"):
+            for unsupported in ("mesh_shape", "devices", "cross_slice_sync",
+                                "elastic"):
                 if kw.pop(unsupported, None) is not None:
                     raise ValueError(
                         f"{unsupported} does not apply to the "
@@ -77,6 +113,7 @@ class Trainer:
         devices=None,
         seed: int = 0,
         seq_parallel=None,  # None = disabled; non-None handled by __new__
+        elastic: Optional[ElasticPolicy] = None,
         **model_overrides,
     ):
         if seq_parallel is not None:
@@ -143,6 +180,19 @@ class Trainer:
                     self.mesh, batch_axis="dp", head_axis="tp")
         self.tx = optax.adamw(learning_rate, weight_decay=weight_decay)
         self.cross_slice_sync = cross_slice_sync
+        if elastic is not None and cross_slice_sync is None:
+            raise ValueError(
+                "elastic= recovers the cross-slice transport world and "
+                "requires cross_slice_sync")
+        self.elastic = elastic
+        # Optimizer steps completed (and, with an elastic policy, the
+        # step number of the last checkpoint when save_every == 1).
+        self.global_step = 0
+        # Stamp the first cross-slice sync (and the first after every
+        # resume) with the step number: all ranks proving they are at
+        # the SAME step before any gradient is averaged is what makes
+        # recovery exact rather than silently mixing batches.
+        self._stamp_sync = cross_slice_sync is not None
 
         rng = jax.random.PRNGKey(seed)
         with self.mesh, self._trace_ctx():
@@ -197,7 +247,7 @@ class Trainer:
     def shard_batch(self, tokens):
         return jax.device_put(tokens, self._data_sharding)
 
-    def step(self, tokens) -> float:
+    def _step_once(self, tokens) -> float:
         """One optimizer step; returns the (pre-update) loss."""
         tokens = self.shard_batch(tokens)
         # _trace_ctx matters only on the first call (trace time); it is
@@ -207,6 +257,12 @@ class Trainer:
                 self.params, self.opt_state, loss = self._jit_full(
                     self.params, self.opt_state, tokens)
             else:
+                if self._stamp_sync:
+                    stamp = getattr(self.cross_slice_sync,
+                                    "set_step_token", None)
+                    if stamp is not None:
+                        stamp(self.global_step)
+                    self._stamp_sync = False
                 loss, grads = self._jit_grads(self.params, tokens)
                 # The cross-slice hop: grads averaged across slices
                 # over the RDMA transport (staged fallback accounts
@@ -214,5 +270,74 @@ class Trainer:
                 grads = self.cross_slice_sync(grads)
                 self.params, self.opt_state = self._jit_apply(
                     self.params, self.opt_state, grads)
-        trace.event("trainer.step", loss=float(loss))
+        return float(loss)
+
+    def _resume(self, exc: BaseException, attempt: int) -> None:
+        """The detect→recover bridge: rebuild the transport world under
+        a new generation, drop the sync layer's ring-bound caches, and
+        restore the last checkpoint so the failed step re-runs from a
+        consistent (params, opt_state, step) snapshot."""
+        trace.event("trainer.resume", step=self.global_step + 1,
+                    attempt=attempt, error=str(exc)[:160])
+        world = getattr(self.cross_slice_sync, "world", None)
+        if world is not None:
+            world.rebuild(**self.elastic.rebuild)
+        reset = getattr(self.cross_slice_sync, "reset_transport_cache", None)
+        if reset is not None:
+            reset()
+        from rocnrdma_tpu.parallel.checkpoint import (checkpoint_file,
+                                                      restore_checkpoint)
+
+        entry_step = self.global_step
+        path = self.elastic.checkpoint_path
+        if os.path.exists(checkpoint_file(path)):
+            restore_checkpoint(path, self)  # also sets self.global_step
+        # else: failure before the first checkpoint — params/opt_state
+        # are still the pre-step values (apply never ran), retry as-is.
+        if self.global_step != entry_step:
+            # The checkpoint rewound PAST the step being retried
+            # (save_every > 1 with intervening uncheckpointed steps):
+            # re-running only the current batch would silently skip
+            # the rolled-back ones. step() cannot replay batches it
+            # never saw — surface it and let the caller drive its data
+            # loop from trainer.global_step.
+            raise RuntimeError(
+                f"elastic resume restored step {self.global_step} but "
+                f"the failed step was {entry_step + 1}: the "
+                f"intervening steps were never checkpointed "
+                f"(save_every={self.elastic.save_every}); re-feed "
+                "batches from trainer.global_step (or use "
+                "save_every=1 for exact in-place replay)")
+        # The retried sync re-proves step agreement across ranks.
+        self._stamp_sync = True
+
+    def step(self, tokens) -> float:
+        """One optimizer step; returns the (pre-update) loss. With an
+        ``elastic=`` policy, retryable transport failures mid-step
+        trigger rebuild→restore→re-run (bounded by ``max_resumes``);
+        successful steps checkpoint every ``save_every`` steps."""
+        if self.elastic is None:
+            loss = self._step_once(tokens)
+        else:
+            from rocnrdma_tpu.transport.engine import TransportError
+
+            resumes = 0
+            while True:
+                try:
+                    loss = self._step_once(tokens)
+                    break
+                except TransportError as e:
+                    if (not getattr(e, "retryable", False)
+                            or resumes >= self.elastic.max_resumes):
+                        raise
+                    resumes += 1
+                    self._resume(e, resumes)
+        self.global_step += 1
+        if (self.elastic is not None and self.elastic.save_every > 0
+                and self.global_step % self.elastic.save_every == 0):
+            from rocnrdma_tpu.parallel.checkpoint import save_checkpoint
+
+            save_checkpoint(self.elastic.checkpoint_path, self,
+                            self.global_step)
+        trace.event("trainer.step", loss=float(loss), step=self.global_step)
         return float(loss)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import re
 import subprocess
 import threading
 from dataclasses import dataclass
@@ -127,6 +128,15 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_mr_invalidate.argtypes = [P]
     lib.tdr_listen.restype = P
     lib.tdr_listen.argtypes = [P, ctypes.c_char_p, ctypes.c_int]
+    lib.tdr_listen_timeout.restype = P
+    lib.tdr_listen_timeout.argtypes = [P, ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int]
+    lib.tdr_fault_plan_clauses.restype = ctypes.c_int
+    lib.tdr_fault_plan_hits.restype = ctypes.c_uint64
+    lib.tdr_fault_plan_hits.argtypes = [ctypes.c_int]
+    lib.tdr_fault_plan_seen.restype = ctypes.c_uint64
+    lib.tdr_fault_plan_seen.argtypes = [ctypes.c_int]
+    lib.tdr_fault_plan_reset.restype = None
     lib.tdr_connect.restype = P
     lib.tdr_connect.argtypes = [P, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.tdr_qp_close.argtypes = [P]
@@ -192,8 +202,54 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_destroy.argtypes = [P]
 
 
+# Completion statuses that signal a TRANSIENT condition — a peer died
+# or a connection dropped (flush), or a wedge/injected fault (general):
+# the world can be rebuilt and the operation retried. Access errors
+# (REM/LOC) are lifetime/programming bugs; retrying cannot fix them.
+_RETRYABLE_STATUSES = frozenset({WC_FLUSH_ERR, WC_GENERAL_ERR})
+_WC_STATUS_RE = re.compile(r"status (\d+)")
+# Message markers for error paths that carry no WC status: stalls and
+# connection loss are transient; everything unrecognized is fatal by
+# default (recovery must be opted into by evidence, not guessed).
+_RETRYABLE_MARKERS = (
+    "timeout",            # poll/accept/connect deadlines — a wedge
+    "connection down",    # post against a dead QP
+    "fault injected",     # TDR_FAULT_PLAN transient
+    "stale ring generation",  # fenced previous-incarnation traffic
+    "never connected",    # rendezvous peer missing
+)
+
+
+def _classify_retryable(message: str, status: Optional[int]) -> bool:
+    if status is not None:
+        return status in _RETRYABLE_STATUSES
+    low = message.lower()
+    return any(marker in low for marker in _RETRYABLE_MARKERS)
+
+
 class TransportError(RuntimeError):
-    pass
+    """Transport failure with an error taxonomy.
+
+    ``status`` is the WC status the failure surfaced with (parsed from
+    the native message when not passed explicitly); ``retryable`` says
+    whether the condition is transient — peer death, connection drop,
+    stall deadline, injected fault — i.e. whether tearing the world
+    down and rebuilding it (``RingWorld.rebuild``) can succeed. Access
+    errors, schedule mismatches, and misuse are fatal: ``retryable``
+    is False and the elastic layer re-raises them.
+    """
+
+    def __init__(self, message: str, status: Optional[int] = None,
+                 retryable: Optional[bool] = None):
+        super().__init__(message)
+        text = str(message)
+        if status is None:
+            m = _WC_STATUS_RE.search(text)
+            if m:
+                status = int(m.group(1))
+        self.status = status
+        self.retryable = (_classify_retryable(text, status)
+                          if retryable is None else bool(retryable))
 
 
 def copy_pool_workers() -> int:
@@ -209,6 +265,49 @@ def copy_counters() -> Tuple[int, int]:
     plain = ctypes.c_uint64()
     _load().tdr_copy_counters(ctypes.byref(nt), ctypes.byref(plain))
     return int(nt.value), int(plain.value)
+
+
+# ------------------------------------------------------------------
+# Fault-plan introspection (TDR_FAULT_PLAN, native fault.cc): tests and
+# the recovery layer read per-clause hit counters so an injected fault
+# is OBSERVABLE — asserted, traced, never assumed.
+
+_fault_hits_noted = [0]
+
+
+def fault_plan_clauses() -> int:
+    """Number of parsed TDR_FAULT_PLAN clauses (0 = no plan)."""
+    return int(_load().tdr_fault_plan_clauses())
+
+
+def fault_plan_hits(idx: int) -> int:
+    """Times clause ``idx`` fired (injected its action)."""
+    return int(_load().tdr_fault_plan_hits(idx))
+
+
+def fault_plan_seen(idx: int) -> int:
+    """Site arrivals clause ``idx`` matched (fired or not)."""
+    return int(_load().tdr_fault_plan_seen(idx))
+
+
+def fault_plan_reset() -> None:
+    """Re-parse TDR_FAULT_PLAN from the environment, zeroing every
+    counter (tests set the env var, then call this)."""
+    _load().tdr_fault_plan_reset()
+    _fault_hits_noted[0] = 0
+
+
+def note_fault_injections() -> int:
+    """Emit a ``fault.injected`` trace event for hits since the last
+    call (the recovery path calls this so injected faults show up in
+    the same observable stream as ``world.rebuild``/``trainer.resume``).
+    Returns the number of new hits."""
+    total = sum(fault_plan_hits(i) for i in range(fault_plan_clauses()))
+    new = total - _fault_hits_noted[0]
+    if new > 0:
+        _fault_hits_noted[0] = total
+        trace.event("fault.injected", hits=new, total=total)
+    return max(new, 0)
 
 
 def _check(cond, what: str):
@@ -591,9 +690,13 @@ class Engine:
         trace.event("mr.reg_dmabuf", bytes=length)
         return MemoryRegion(self, h)
 
-    def listen(self, host: str = "127.0.0.1", port: int = 0) -> QueuePair:
-        h = _load().tdr_listen(_live(self._h, "listen"), host.encode(),
-                               port)
+    def listen(self, host: str = "127.0.0.1", port: int = 0,
+               timeout_ms: int = -1) -> QueuePair:
+        """Accept one connection (blocking). ``timeout_ms`` bounds the
+        accept wait (-1 = forever): elastic rendezvous must be able to
+        give up and release the port for the next attempt."""
+        h = _load().tdr_listen_timeout(_live(self._h, "listen"),
+                                       host.encode(), port, timeout_ms)
         _check(h, "listen")
         return QueuePair(self, h)
 

@@ -52,6 +52,14 @@ class _Tracer:
         with self._lock:
             return dict(self._counters)
 
+    def counters_prefixed(self, prefix: str) -> Dict[str, int]:
+        """Counters under a dotted namespace (e.g. ``"world."`` →
+        ``world.up``/``world.rebuild``/…) — the recovery tests assert
+        whole-path observability with one call."""
+        with self._lock:
+            return {k: v for k, v in self._counters.items()
+                    if k.startswith(prefix)}
+
     def events(self, name: str | None = None) -> List[Tuple[float, str, Dict[str, Any]]]:
         with self._lock:
             evs = list(self._ring)

@@ -45,6 +45,13 @@ os.environ.setdefault("TDR_RING_TIMEOUT_MS", "120000")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests excluded from the tier-1 gate "
+        "(ROADMAP.md runs -m 'not slow' under a wall-clock budget)")
+
+
 @pytest.fixture(autouse=True)
 def _reset_trace():
     from rocnrdma_tpu.utils.trace import trace

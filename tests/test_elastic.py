@@ -1,0 +1,177 @@
+"""Elastic training: SIGKILL a rank mid-training, restart it, resume.
+
+The acceptance test of the recovery layer: two single-device trainers
+(separate PROCESSES, cross-slice grads over the emu ring) train N
+steps; rank 1 SIGKILLs itself inside a step's gradient sync. Rank 0's
+elastic policy detects the retryable failure, rebuilds the world
+(``RingWorld.rebuild`` — backoff until the restarted rank re-joins
+under the bumped generation), restores its checkpoint, and re-runs the
+step; the restarted rank 1 restores ITS checkpoint at startup and
+rejoins the same rendezvous. Final params must be BITWISE equal to an
+uninterrupted run at the same step count — recovery is exact, not
+approximate.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEPS = 4
+DIE_AT = 2  # rank 1 SIGKILLs itself inside step 2's gradient sync
+
+# One rank of the elastic training job. argv: rank base_port steps
+# ckpt_dir die_at (0 = never).
+RANK_SCRIPT = r"""
+import os, signal, sys
+import numpy as np
+
+rank = int(sys.argv[1]); base = int(sys.argv[2]); steps = int(sys.argv[3])
+ckdir = sys.argv[4]; die_at = int(sys.argv[5])
+
+from rocnrdma_tpu.transport.engine import Engine
+from rocnrdma_tpu.collectives.world import RingWorld
+from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+from rocnrdma_tpu.parallel.trainer import ElasticPolicy, Trainer
+from rocnrdma_tpu.parallel.checkpoint import restore_checkpoint, \
+    save_checkpoint
+from rocnrdma_tpu.utils.trace import trace
+
+eng = Engine("emu")
+world = RingWorld(eng, rank, 2, base, timeout_ms=60000)
+sync = CrossSliceAllReduce(world, mean=True)
+
+
+class KillSwitch:
+    '''SIGKILL this process on its Nth gradient sync — "a rank dies
+    mid-step", deterministically.'''
+
+    def __init__(self, inner, at):
+        self.inner = inner
+        self.at = at
+        self.n = 0
+
+    def __call__(self, tree):
+        self.n += 1
+        if self.at > 0 and self.n == self.at:
+            os.kill(os.getpid(), signal.SIGKILL)
+        return self.inner(tree)
+
+    def __getattr__(self, name):  # .world / .reset_transport_cache
+        return getattr(self.inner, name)
+
+
+sync = KillSwitch(sync, die_at)
+ck = os.path.join(ckdir, f"rank{rank}")
+tr = Trainer("llama-tiny", {"dp": 1, "tp": 1}, seed=5, learning_rate=1e-2,
+             cross_slice_sync=sync,
+             elastic=ElasticPolicy(ck, save_every=1, max_resumes=6,
+                                   rebuild=dict(max_attempts=12,
+                                                backoff_s=0.2,
+                                                backoff_cap_s=2.0,
+                                                timeout_ms=20000)))
+start = 0
+if os.path.exists(ck + ".npz"):
+    start = restore_checkpoint(ck, tr)
+    print("RESTORED", rank, start, flush=True)
+
+rng = np.random.default_rng(17)
+batches = [rng.integers(0, 255, (2, 2, 17)).astype(np.int32)
+           for _ in range(steps)]
+for i in range(start, steps):
+    tr.step(batches[i][rank])
+
+save_checkpoint(os.path.join(ckdir, f"final{rank}"), tr, steps)
+print("DONE", rank, "resume=%d" % trace.counter("trainer.resume"),
+      "rebuild=%d" % trace.counter("world.rebuild"),
+      "restore=%d" % trace.counter("ckpt.restore"), flush=True)
+"""
+
+
+def _free_base():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(rank, base, ckdir, die_at):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # Dead-peer detection rides the TCP close (fast); the deadline is
+    # only the wedge backstop and must stay inside the harness timeout.
+    env["TDR_RING_TIMEOUT_MS"] = "30000"
+    return subprocess.Popen(
+        [sys.executable, "-c", RANK_SCRIPT, str(rank), str(base),
+         str(STEPS), ckdir, str(die_at)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _finish(proc, timeout=420):
+    out, err = proc.communicate(timeout=timeout)
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout:\n{out}\nstderr:\n{err}")
+    return out
+
+
+def _final_params(ckdir, rank):
+    with np.load(os.path.join(ckdir, f"final{rank}.npz")) as z:
+        return {k: z[k].copy() for k in z.files
+                if k.startswith(("params/", "__dtype__/params/"))}
+
+
+def _run_pair(ckdir, die_at):
+    base = _free_base()
+    p0 = _spawn(0, base, ckdir, 0)
+    p1 = _spawn(1, base, ckdir, die_at)
+    out1 = None
+    if die_at:
+        # Rank 1 kills itself mid-step; restart it, exactly as a
+        # supervisor (k8s, slurm) would.
+        p1.wait(timeout=300)
+        assert p1.returncode == -signal.SIGKILL, p1.returncode
+        p1b = _spawn(1, base, ckdir, 0)
+        out1 = _finish(p1b)
+        # The restarted rank must have come back from ITS checkpoint,
+        # not from scratch.
+        assert "RESTORED 1" in out1, out1
+    else:
+        out1 = _finish(p1)
+    out0 = _finish(p0)
+    return out0, out1
+
+
+def test_sigkill_restart_resumes_bitwise_equal(tmp_path):
+    clean_dir = str(tmp_path / "clean")
+    faulty_dir = str(tmp_path / "faulty")
+    os.makedirs(clean_dir)
+    os.makedirs(faulty_dir)
+
+    clean0, _ = _run_pair(clean_dir, die_at=0)
+    faulty0, faulty1 = _run_pair(faulty_dir, die_at=DIE_AT)
+
+    # The surviving rank recovered through the full path: resume →
+    # rebuild → checkpoint restore, all observable in its counters.
+    done = [l for l in faulty0.splitlines() if l.startswith("DONE 0")]
+    assert done, faulty0
+    assert "resume=0" not in done[0], done[0]
+    assert "rebuild=0" not in done[0], done[0]
+    assert "restore=0" not in done[0], done[0]
+
+    # Bitwise parity: interrupted+recovered == uninterrupted, and the
+    # two ranks of the faulty run stayed in DP lockstep.
+    clean = _final_params(clean_dir, 0)
+    faulty = _final_params(faulty_dir, 0)
+    faulty_r1 = _final_params(faulty_dir, 1)
+    assert set(clean) == set(faulty)
+    for key in clean:
+        assert clean[key].tobytes() == faulty[key].tobytes(), key
+    for key in faulty:
+        assert faulty[key].tobytes() == faulty_r1[key].tobytes(), key

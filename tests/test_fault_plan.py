@@ -1,0 +1,270 @@
+"""Deterministic fault plans (TDR_FAULT_PLAN) and elastic-world tests.
+
+The recovery layer's contract has two observable halves: (a) injected
+faults are DETERMINISTIC — the exported per-clause hit counters match
+the plan, never "the test was green because the fault silently failed
+to arm" — and (b) detection leads to recovery: a wedged ring rebuilds
+on the same Engine under a bumped generation, and traffic from a
+previous incarnation is fenced off by the generation stamp in the
+schedule digest.
+"""
+
+import hashlib
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.transport.engine import (
+    Engine, TransportError, WC_GENERAL_ERR, WC_SUCCESS, fault_plan_clauses,
+    fault_plan_hits, fault_plan_reset, fault_plan_seen, loopback_pair)
+from rocnrdma_tpu.utils.trace import trace
+
+_port_counter = [21100 + (os.getpid() % 400)]
+
+
+def _port():
+    _port_counter[0] += 9
+    return _port_counter[0]
+
+
+@pytest.fixture
+def fault_plan(monkeypatch):
+    """Arm a TDR_FAULT_PLAN for one test; disarm afterwards (BEFORE
+    monkeypatch restores the env, so the registry never re-parses a
+    dead plan)."""
+
+    def arm(spec: str) -> None:
+        monkeypatch.setenv("TDR_FAULT_PLAN", spec)
+        fault_plan_reset()
+
+    yield arm
+    monkeypatch.delenv("TDR_FAULT_PLAN", raising=False)
+    fault_plan_reset()
+
+
+def test_no_plan_means_no_clauses(fault_plan):
+    fault_plan_reset()
+    del fault_plan
+    assert fault_plan_clauses() == 0
+
+
+def test_bad_clause_is_ignored_loudly(fault_plan):
+    fault_plan("bogus_site:once=general_err,send:nth=1:once=general_err")
+    assert fault_plan_clauses() == 1  # the valid clause survives
+
+
+def test_site_action_mismatch_rejected(fault_plan):
+    """Clauses whose action the site cannot apply must be rejected at
+    parse time — a counted-but-unapplied injection would be exactly
+    the lie the hit counters exist to prevent."""
+    fault_plan("land:once=general_err,conn:always=flush_err,"
+               "ring:drop_after=2,land:stall_ms=5")
+    assert fault_plan_clauses() == 1  # only the land stall is valid
+
+
+def test_send_chunk_once_fires_exactly_once(fault_plan):
+    """`send:chunk=3:once=general_err`: the WR whose low-48-bit chunk
+    index is 3 completes with GENERAL_ERR instead of transmitting —
+    once — and the hit counter proves it fired."""
+    fault_plan("send:chunk=3:once=general_err")
+    e = Engine("emu")
+    a, b = loopback_pair(e, _port())
+    src = np.zeros(256, dtype=np.uint8)
+    inbox = np.zeros(256, dtype=np.uint8)
+    smr, rmr = e.reg_mr(src), e.reg_mr(inbox)
+    for i in range(5):
+        b.post_recv(rmr, 0, 256, wr_id=100 + i)
+    for i in range(5):
+        a.post_send(smr, 0, 64, wr_id=i)
+    statuses = {}
+    for _ in range(20):
+        for wc in a.poll(max_wc=8, timeout_ms=10000):
+            statuses[wc.wr_id] = wc.status
+        if len(statuses) == 5:
+            break
+    assert statuses[3] == WC_GENERAL_ERR
+    for i in (0, 1, 2, 4):
+        assert statuses[i] == WC_SUCCESS
+    assert fault_plan_clauses() == 1
+    assert fault_plan_hits(0) == 1
+    # seen counts arrivals the clause MATCHED (post-chunk-filter): only
+    # the chunk-3 WR.
+    assert fault_plan_seen(0) == 1
+    # only 4 messages actually crossed the wire
+    got = 0
+    for _ in range(20):
+        got += len(b.poll(max_wc=8, timeout_ms=10000))
+        if got == 4:
+            break
+    assert got == 4
+    smr.deregister()
+    a.close(); b.close()
+    rmr.deregister()
+    e.close()
+
+
+def test_conn_drop_after_posts(fault_plan):
+    """`conn:drop_after=2`: the first two posts go through, the third
+    finds the connection dead — deterministic RC connection loss, and
+    the peer observes flush semantics."""
+    fault_plan("conn:drop_after=2")
+    e = Engine("emu")
+    a, b = loopback_pair(e, _port())
+    src = np.zeros(64, dtype=np.uint8)
+    inbox = np.zeros(64, dtype=np.uint8)
+    smr, rmr = e.reg_mr(src), e.reg_mr(inbox)
+    for i in range(3):
+        b.post_recv(rmr, 0, 64, wr_id=200 + i)
+    a.post_send(smr, 0, 64, wr_id=0)
+    a.post_send(smr, 0, 64, wr_id=1)
+    # The conn clause shuts the socket down inside the third post; the
+    # submit then fails with "post: connection down" — retryable.
+    with pytest.raises(TransportError) as ei:
+        a.post_send(smr, 0, 64, wr_id=2)
+    assert ei.value.retryable, ei.value
+    assert fault_plan_hits(0) == 1
+    a.close(); b.close()
+    smr.deregister(); rmr.deregister()
+    e.close()
+
+
+def _local_worlds(n, port):
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    return local_worlds(n, port)
+
+
+def test_ring_fault_then_rebuild_recovers(fault_plan, monkeypatch):
+    """The detect→recover loop without process death: an injected
+    transient collective fault surfaces as a retryable TransportError
+    on one rank, the teardown flushes the other, BOTH rebuild on the
+    same Engines under generation 1, and the next allreduce is
+    correct. Asserts the exported hit counter matches the plan and
+    the whole path is observable in trace counters."""
+    monkeypatch.setenv("TDR_RING_TIMEOUT_MS", "30000")
+    fault_plan("ring:nth=1:once=general_err")
+    worlds = _local_worlds(2, _port())
+    assert [w.generation for w in worlds] == [0, 0]
+    errs = [None, None]
+
+    def run(r):
+        buf = np.full(4096, float(r + 1), dtype=np.float32)
+        try:
+            worlds[r].allreduce(buf)
+        except TransportError as e:
+            errs[r] = e
+            worlds[r].rebuild(max_attempts=8, backoff_s=0.05,
+                              timeout_ms=10000)
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # One rank got the injection; the other was flushed by its
+    # teardown. Both are retryable — the elastic layer's trigger.
+    assert all(e is not None and e.retryable for e in errs), errs
+    assert fault_plan_hits(0) == 1  # the plan fired exactly once
+    assert [w.generation for w in worlds] == [1, 1]
+    # The rebuilt incarnation works.
+    bufs = [np.full(4096, float(r + 1), dtype=np.float32) for r in range(2)]
+    ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for b in bufs:
+        np.testing.assert_array_equal(b, np.full(4096, 3.0, np.float32))
+    # Whole-path observability: injection and rebuild both traced.
+    assert trace.counter("fault.injected") >= 1
+    assert trace.counter("world.rebuild") >= 2
+    for w in worlds:
+        w.close()
+
+
+def test_generation_fencing_rejects_stale_incarnation():
+    """A rank still on a previous incarnation (it missed a rebuild)
+    must be FENCED at the first collective: the generation stamped
+    into the schedule digest mismatches, and both sides raise a
+    retryable stale-generation error instead of desynchronizing the
+    ring."""
+    worlds = _local_worlds(2, _port())
+    worlds[1].generation = 99  # stale/foreign incarnation
+    digest = hashlib.sha256(b"layout").digest()
+    errs = [None, None]
+
+    def run(r):
+        try:
+            worlds[r].check_schedule(digest, "fence-test")
+        except TransportError as e:
+            errs[r] = e
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(e is not None for e in errs), errs
+    assert any("generation" in str(e) for e in errs), errs
+    assert all(e.retryable for e in errs), errs
+    for w in worlds:
+        w.close()
+
+
+def test_rebuild_after_peer_teardown_reuses_engine(monkeypatch):
+    """Engine-reusability half of the teardown contract: after a
+    wedge (peer QPs closed under us mid-world), rebuild() on the SAME
+    Engine objects converges and the new ring carries traffic."""
+    monkeypatch.setenv("TDR_RING_TIMEOUT_MS", "20000")
+    worlds = _local_worlds(2, _port())
+    engines = [w.engine for w in worlds]
+
+    def rb(r):
+        worlds[r].rebuild(max_attempts=8, backoff_s=0.05, timeout_ms=10000)
+
+    ts = [threading.Thread(target=rb, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert [w.generation for w in worlds] == [1, 1]
+    assert [w.engine for w in worlds] == engines
+    bufs = [np.full(257, float(r + 1), dtype=np.float32) for r in range(2)]
+    ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for b in bufs:
+        np.testing.assert_array_equal(b, np.full(257, 3.0, np.float32))
+    for w in worlds:
+        w.close()
+
+
+def test_rebuild_budget_exhaustion_is_fatal():
+    """A rebuild whose peers never arrive must exhaust its bounded
+    budget and raise a NON-retryable error (the caller must not spin
+    forever on a world that cannot come back)."""
+    worlds = _local_worlds(2, _port())
+    worlds[1].close()  # rank 1 is gone and will not rendezvous
+    with pytest.raises(TransportError) as ei:
+        worlds[0].rebuild(max_attempts=2, backoff_s=0.05,
+                          timeout_ms=400)
+    assert not ei.value.retryable
+    assert "rebuild failed" in str(ei.value)
+    worlds[0].close()
+
+
+def test_listen_timeout_bounds_accept():
+    """Engine.listen with a deadline returns (with a retryable error)
+    instead of stranding a thread in accept holding the port."""
+    e = Engine("emu")
+    with pytest.raises(TransportError) as ei:
+        e.listen("127.0.0.1", _port(), timeout_ms=300)
+    assert "timeout" in str(ei.value).lower()
+    assert ei.value.retryable
+    e.close()

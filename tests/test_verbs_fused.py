@@ -331,6 +331,95 @@ def test_verbs_emu_cross_backend_parity():
                                   results["verbs:mock0"][1])
 
 
+def test_ring_alltoall_world2_direct_exchange_over_mock_verbs():
+    """The world=2 all-to-all fast path (ONE foreign segment each way,
+    received directly into place, only the outgoing segment staged)
+    on the UNMODIFIED verbs engine against the mock provider. The
+    general bundle path is covered at world=3 below; this pins the
+    direct-exchange branch, which posts against a per-call MR pinned
+    over just the received segment."""
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    worlds = local_worlds(2, _port(), spec="verbs:mock0")
+    seg = 4099  # prime: stresses offset math
+    def fill(r):
+        return np.concatenate(
+            [1000.0 * r + 10 * j + np.arange(seg) % 7
+             for j in range(2)]).astype(np.float32)
+    bufs = [fill(r) for r in range(2)]
+    errs = [None, None]
+
+    def run(r):
+        try:
+            worlds[r].all_to_all(bufs[r])
+        except BaseException as exc:  # surfaced after join
+            errs[r] = exc
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for exc in errs:
+        if exc is not None:
+            raise exc
+    for r in range(2):
+        want = np.concatenate(
+            [1000.0 * j + 10 * r + np.arange(seg) % 7
+             for j in range(2)]).astype(np.float32)
+        np.testing.assert_array_equal(bufs[r], want)
+    for w in worlds:
+        w.close()
+
+
+def test_ring_alltoall_world2_cached_full_buffer_mr_over_mock_verbs():
+    """Same exchange with a PRE-REGISTERED full-buffer MR
+    (Ring.register_buffer): the direct-exchange path must take the
+    cached-MR branch — receiving at the segment's offset inside the
+    full-buffer registration instead of pinning per call — and stay
+    correct across repeated (steady-state) exchanges."""
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    worlds = local_worlds(2, _port(), spec="verbs:mock0")
+    seg = 2048
+    bufs = [np.zeros(2 * seg, dtype=np.float32) for _ in range(2)]
+    for r in range(2):
+        worlds[r].ring.register_buffer(bufs[r])  # front-loaded MR
+
+    for round_no in range(2):  # steady-state reuse of the cached MR
+        for r in range(2):
+            for j in range(2):
+                bufs[r][j * seg:(j + 1) * seg] = (
+                    100.0 * r + 10 * j + round_no
+                    + np.arange(seg) % 5)
+        errs = [None, None]
+
+        def run(r):
+            try:
+                worlds[r].all_to_all(bufs[r])
+            except BaseException as exc:  # surfaced after join
+                errs[r] = exc
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for exc in errs:
+            if exc is not None:
+                raise exc
+        for r in range(2):
+            for j in range(2):
+                want = (100.0 * j + 10 * r + round_no
+                        + np.arange(seg) % 5).astype(np.float32)
+                np.testing.assert_array_equal(
+                    bufs[r][j * seg:(j + 1) * seg], want)
+    for r in range(2):
+        worlds[r].ring.unregister_buffer(bufs[r])
+    for w in worlds:
+        w.close()
+
+
 def test_ring_alltoall_over_mock_verbs():
     """The all-to-all's ChainPump send/recv path is engine-agnostic:
     the same segment-transpose contract holds with the UNMODIFIED

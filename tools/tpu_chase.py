@@ -89,8 +89,13 @@ def sane_tflops(tf):
 for k in (4096, 8192):
     a = jnp.ones((k, k), jnp.bfloat16); b = jnp.ones((k, k), jnp.bfloat16)
     iters = 10
+    # Scale each chained product by 1/k: all-ones operands make y@b
+    # equal k per element, so the unscaled chain overflows bf16 to inf
+    # within a few iterations at k=8192 — timing inf arithmetic, not a
+    # matmul. The scale keeps chained values at 1.0; its FLOP cost is
+    # O(k^2), noise against the 2k^3 matmul being measured.
     mm = jax.jit(lambda a_: jax.lax.fori_loop(
-        0, iters, lambda i, y: y @ b, a_))
+        0, iters, lambda i, y: (y @ b) * (1.0 / k), a_))
     r = mm(a); _sync(r)
     f0 = time.perf_counter(); _sync(r)
     fence_s = time.perf_counter() - f0

@@ -90,12 +90,19 @@ def main():
         run_ranks(W, fwd_bwd)  # warm: compiles + staging buffers
         staging.reset()
         iters = 3
+        # Accumulate reshard time across ALL timed iterations so the
+        # fraction below compares a per-iteration mean against the
+        # per-iteration mean wall — not one iteration's sample against
+        # a 3-iteration mean.
+        fr_sum = br_sum = 0.0
         t0 = time.perf_counter()
         for _ in range(iters):
             res = run_ranks(W, fwd_bwd)
+            fr_sum += max(r[0] for r in res)
+            br_sum += max(r[1] for r in res)
         wall = (time.perf_counter() - t0) / iters
-        fr = max(r[0] for r in res)
-        br = max(r[1] for r in res)
+        fr = fr_sum / iters
+        br = br_sum / iters
         out["wall_s_per_call"] = round(wall, 4)
         out["fwd_reshard_s"] = round(fr, 4)
         out["bwd_reshard_s"] = round(br, 4)
