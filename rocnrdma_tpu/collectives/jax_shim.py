@@ -362,10 +362,15 @@ class CrossSliceAllReduce:
             and self.world.left_qp.has_send_foldback
             and self.world.right_qp.has_send_foldback
             and os.environ.get("TDR_NO_WAVE_FB", "0") in ("", "0"))
+        # Seal config is frame-format-changing (trailer on/off, size)
+        # and retry-ladder-changing (budget): ranks that disagree must
+        # fail the digest here, fast and explicably, never mis-parse
+        # each other's frames or diverge on when to escalate.
         sched = [f"world={self.world.world}",
                  f"chunk={os.environ.get('TDR_RING_CHUNK', '')}",
                  f"schunk={self._stage_chunk()}",
-                 f"mean={int(self.mean)}", f"wfb={wfb}"]
+                 f"mean={int(self.mean)}", f"wfb={wfb}",
+                 f"seal={getattr(self.world, 'seal_config', '')}"]
         sched += [f"z:{nbytes}:{arr.dtype}" for _, nbytes, arr in coalesced]
         sched += [f"j:{nbytes}:{buf.dtype}" for _, nbytes, buf in jax_ops]
         # Per-leaf sizes (not just the sum): ranks with different
@@ -612,6 +617,11 @@ class CrossSliceAllReduce:
         same step is what proves their checkpoints agree before any
         gradient is averaged."""
         self._step_token = int(step)
+        # Also stamp the transport seals: every sealed chunk from here
+        # carries the step in its CRC-covered tag.
+        stamp = getattr(self.world, "set_seal_step", None)
+        if stamp is not None:
+            stamp(step)
 
     def reset_transport_cache(self) -> None:
         """Forget ring-bound state after ``RingWorld.rebuild()``: the

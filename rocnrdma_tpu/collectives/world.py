@@ -38,7 +38,9 @@ import numpy as np
 
 from rocnrdma_tpu.transport.engine import (Engine, QueuePair, Ring, RED_SUM,
                                            TransportError,
-                                           note_fault_injections)
+                                           note_fault_injections,
+                                           note_integrity,
+                                           seal_retry_budget)
 from rocnrdma_tpu.utils.trace import trace
 
 # wr_id tags for the schedule-digest exchange — distinct from the
@@ -86,6 +88,13 @@ class RingWorld:
         self.right_qp: Optional[QueuePair] = None
         self.ring: Optional[Ring] = None
         self._barrier_buf = None
+        # Seal configuration string, fixed per incarnation at
+        # bootstrap: part of the schedule digest (jax_shim) so a rank
+        # pair with mismatched seal settings fails fast with a
+        # schedule-mismatch error instead of mis-parsing frames.
+        self.seal_config = ""
+        # Training step stamped into outbound seals (set_seal_step).
+        self._seal_step = 0
         # Schedule-digest buffers (check_schedule), registered lazily
         # on the ENGINE (they survive rebuilds; QPs do not).
         self._dg_send = self._dg_recv = None
@@ -103,6 +112,17 @@ class RingWorld:
         closed); the Engine stays reusable."""
         rank, world = self.rank, self.world
         right = (rank + 1) % world
+        # Drop any seal stamp retained from a previous incarnation
+        # BEFORE new QPs come up: bootstrap's generation-reconciliation
+        # frames must travel unfenced (wire gen 0). Without this, a
+        # rebuild where one rank stamped its new generation while its
+        # neighbor's attempt failed pre-stamp would integrity-fence
+        # the reconciliation itself on every retry — a livelock in
+        # exactly the fault regime rebuild() exists to survive. Ghost
+        # frames from the old incarnation cannot reach the new QPs
+        # (connections are incarnation-scoped), so the fence loses
+        # nothing during the window.
+        self.engine.clear_seal_context()
         accepted: List[Optional[QueuePair]] = [None]
         err: List[Optional[BaseException]] = [None]
 
@@ -143,6 +163,16 @@ class RingWorld:
             self._barrier_buf = None
             self._ensure_digest_bufs()
             self._exchange_generation(timeout_ms)
+            # Seal context only AFTER the ring agreed on a generation:
+            # during the exchange itself ranks may legitimately hold
+            # different proposals, and a premature stamp would fence
+            # the very frames that reconcile them. From here on, every
+            # outbound seal names this incarnation and stale-world
+            # ghosts fail verification.
+            self.engine.set_seal_context(self.generation, self._seal_step)
+            self.seal_config = (
+                f"seal={int(bool(self.left_qp.has_seal))}"
+                f":retry={seal_retry_budget()}")
         except BaseException:
             self._teardown()
             raise
@@ -205,6 +235,14 @@ class RingWorld:
         that passed through them (use allreduce when every rank needs
         the result intact)."""
         self.ring.reduce(array, root, op)
+
+    def set_seal_step(self, step: int) -> None:
+        """Stamp the training step into outbound seals (informational
+        but CRC-covered: a corrupted tag fails verification like a
+        corrupted payload). The sync layer forwards the elastic
+        trainer's step token here."""
+        self._seal_step = int(step)
+        self.engine.set_seal_context(self.generation, self._seal_step)
 
     def barrier(self) -> None:
         """Collective barrier: no rank returns before every rank has
@@ -385,6 +423,7 @@ class RingWorld:
         ``TransportError`` when the budget is exhausted."""
         timeout = int(self.timeout_ms if timeout_ms is None else timeout_ms)
         note_fault_injections()
+        note_integrity()
         self._teardown()
         self.generation += 1
         trace.event("world.rebuild", rank=self.rank, phase="begin",
@@ -398,6 +437,7 @@ class RingWorld:
             try:
                 self._bootstrap(timeout)
                 note_fault_injections()
+                note_integrity()
                 trace.event("world.rebuild", rank=self.rank, phase="ok",
                             generation=self.generation, attempts=attempt)
                 return self

@@ -48,6 +48,11 @@ enum {
   TDR_WC_LOC_ACCESS_ERR = 2, /* local MR invalid / recv too small */
   TDR_WC_FLUSH_ERR = 3,      /* QP torn down with the op in flight */
   TDR_WC_GENERAL_ERR = 4,
+  /* Payload-integrity verification failed at land time and the
+   * per-chunk retransmit budget is exhausted (or the frame carried a
+   * stale-incarnation seal). Retryable: the elastic layer rebuilds the
+   * world, exactly as it does for FLUSH/GENERAL. */
+  TDR_WC_INTEGRITY_ERR = 5,
 };
 
 /* MR access flags (ibv_access_flags semantics). */
@@ -95,10 +100,13 @@ void tdr_copy_counters(uint64_t *nt_bytes, uint64_t *plain_bytes);
  * drops after N posts), land (the landing-time window; generalizes
  * TDR_FAULT_LANDING_DELAY_MS), ring (tdr_ring_allreduce entry: the
  * collective call fails before posting). Status actions are valid at
- * send/ring only, drop_after at conn only, stall_ms anywhere;
- * clauses whose action the site cannot apply are rejected at parse
- * time so a hit counter never reports an injection that did not
- * happen.
+ * send/ring only, drop_after at conn only, corrupt=NBYTES at
+ * send/land only (flip NBYTES payload bytes AFTER sealing on send /
+ * BEFORE verification on land — sealed connections only; the source
+ * buffer is never touched, so retransmissions can be clean),
+ * stall_ms anywhere; clauses whose action the site cannot apply are
+ * rejected at parse time so a hit counter never reports an injection
+ * that did not happen.
  *
  * Per-clause hit counters are exported so tests assert the fault
  * ACTUALLY fired — a green test whose fault never armed is a lie.
@@ -108,6 +116,50 @@ int tdr_fault_plan_clauses(void);
 uint64_t tdr_fault_plan_hits(int idx);  /* times clause idx fired   */
 uint64_t tdr_fault_plan_seen(int idx);  /* site arrivals it matched */
 void tdr_fault_plan_reset(void);
+
+/* ------------------------------------------------------------------ *
+ * Sealed chunks — end-to-end payload integrity on the emu transport.
+ *
+ * When both ends of a QP negotiate FEAT_SEAL (default on; TDR_NO_SEAL
+ * opts a rank out at the handshake), every payload-bearing frame
+ * (SEND/recv_reduce/foldback and RDMA_WRITE landings) carries a seal:
+ * CRC32C over the payload plus a (generation, step, chunk-seq) tag,
+ * verified at land time BEFORE the chunk is folded into any
+ * accumulator. A verification failure NAKs the chunk seq back to the
+ * sender, which re-posts it from the still-live source buffer (the
+ * pending op holds an inflight MR ref until the final ack); the
+ * per-chunk retransmit budget is TDR_SEAL_RETRY (default 3), and
+ * exhausting it completes BOTH sides' WRs with TDR_WC_INTEGRITY_ERR —
+ * retryable, so the elastic layer escalates to RingWorld.rebuild().
+ * ------------------------------------------------------------------ */
+
+/* CRC32C (Castagnoli, reflected 0x82F63B78): hardware (SSE4.2) when
+ * compiled in, software slicing otherwise. Incremental: pass the
+ * previous return value as seed to continue a running checksum. */
+uint32_t tdr_crc32c(const void *data, size_t len, uint32_t seed);
+
+/* Process-wide integrity counters: out[0]=frames sealed at send,
+ * out[1]=landings verified ok, out[2]=verification failures,
+ * out[3]=retransmissions performed. */
+void tdr_seal_counters(uint64_t out[4]);
+void tdr_seal_counters_reset(void);
+
+/* The per-chunk retransmit budget as the ENGINE parses it
+ * (TDR_SEAL_RETRY, default 3) — the schedule digest records this
+ * value, so the Python layer must not re-parse the env and risk
+ * certifying a budget the transport is not using. */
+int tdr_seal_retry_budget(void);
+
+/* Stamp the engine's seal context: gen_plus1 = ring incarnation + 1
+ * (0 = unset, checks skipped) and the training step. Outbound seals
+ * carry both; a landing whose seal names a DIFFERENT non-zero
+ * incarnation than the local engine's is a stale-incarnation ghost
+ * write and fails verification even when its bytes are intact. */
+void tdr_seal_context(tdr_engine *e, uint64_t gen_plus1, uint64_t step);
+
+/* Whether this QP negotiated sealing with its peer (emu only; the
+ * verbs backend relies on the wire's ICRC and advertises 0). */
+int tdr_qp_has_seal(tdr_qp *qp);
 
 /* spec: "emu", "verbs", "verbs:<device>", or "auto" (verbs, else emu). */
 tdr_engine *tdr_engine_open(const char *spec);

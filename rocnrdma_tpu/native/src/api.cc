@@ -36,6 +36,29 @@ uint64_t tdr_fault_plan_seen(int idx) {
 
 void tdr_fault_plan_reset(void) { tdr::fault_plan_reset(); }
 
+/* Sealed-chunk integrity surface: CRC32C for tests, process-wide
+ * sealed/verified/failed/retransmitted counters, and the per-engine
+ * incarnation context stamped into seals. */
+uint32_t tdr_crc32c(const void *data, size_t len, uint32_t seed) {
+  return tdr::crc32c(data, len, seed);
+}
+
+void tdr_seal_counters(uint64_t out[4]) {
+  for (int i = 0; i < 4; i++) out[i] = tdr::seal_counter(i);
+}
+
+void tdr_seal_counters_reset(void) { tdr::seal_counters_reset(); }
+
+int tdr_seal_retry_budget(void) { return tdr::seal_retry_budget(); }
+
+void tdr_seal_context(tdr_engine *e, uint64_t gen_plus1, uint64_t step) {
+  if (e) reinterpret_cast<Engine *>(e)->set_seal_ctx(gen_plus1, step);
+}
+
+int tdr_qp_has_seal(tdr_qp *qp) {
+  return reinterpret_cast<Qp *>(qp)->has_seal() ? 1 : 0;
+}
+
 tdr_engine *tdr_engine_open(const char *spec) {
   std::string s = spec ? spec : "auto";
   std::string err;

@@ -79,6 +79,11 @@ class Qp {
   // straight off the wire). The ring layer sizes its recv window to
   // this so staging memory stays at window * chunk bytes.
   virtual size_t rr_window_hint() const { return 0; }
+  // Whether payload sealing (CRC32C + incarnation tag, NAK/retransmit
+  // on verify failure) was negotiated with the peer. Emu-only: the
+  // verbs wire has ICRC; host-side sealing there would double-touch
+  // every byte for protection the link already provides.
+  virtual bool has_seal() const { return false; }
   virtual int poll(tdr_wc *wc, int max, int timeout_ms) = 0;
   virtual int close_qp() = 0;
 };
@@ -97,6 +102,10 @@ class Engine {
   // a port the next rendezvous attempt needs.
   virtual Qp *listen(const char *bind_host, int port, int timeout_ms) = 0;
   virtual Qp *connect(const char *host, int port, int timeout_ms) = 0;
+  // Seal context (tdr_seal_context): the incarnation+1 and training
+  // step stamped into outbound seals and checked at land time. A
+  // no-op on engines without sealing (verbs).
+  virtual void set_seal_ctx(uint64_t /*gen_plus1*/, uint64_t /*step*/) {}
 };
 
 Engine *create_emu_engine(std::string *err);
@@ -115,6 +124,11 @@ enum : uint32_t {
   // FusedTwo sends phase-2 reduced-B chunks on its LEFT QP while the
   // rightward-only schedules send everything rightward.
   FEAT_FUSED2 = 1u << 1,
+  // Payload sealing (CRC32C + incarnation tag trailers, NAK-driven
+  // chunk retransmit). Frame-changing: sealed frames carry a trailer
+  // the unsealed parser would misread as the next header, so it MUST
+  // be negotiated (TDR_NO_SEAL acts at the advertising stage).
+  FEAT_SEAL = 1u << 2,
 };
 
 // Locally-willing feature set (TDR_NO_FOLDBACK / TDR_NO_FUSED2 act
@@ -139,6 +153,13 @@ int ring_timeout_ms();
 constexpr int TDR_FAULT_NONE = -1;
 constexpr int TDR_FAULT_DROP = -2;
 int fault_point(const char *site, long long chunk = -1);
+// Corruption injection (sealed connections): returns the number of
+// payload bytes a matching corrupt=N clause wants flipped at this
+// arrival (0 = none). Corrupt clauses are evaluated ONLY here — never
+// by fault_point — so their seen/hit counters stay truthful. Valid
+// sites: send (frame transmission time, wire copy only) and land
+// (after the payload materializes, before verification).
+long long fault_corrupt(const char *site, long long chunk = -1);
 // The landing-window hook: honors the legacy TDR_FAULT_LANDING_DELAY_MS
 // knob, then the plan's "land" site.
 void fault_land_delay();
@@ -147,6 +168,30 @@ uint64_t fault_clause_hits(size_t idx);
 uint64_t fault_clause_seen(size_t idx);
 // Re-parse TDR_FAULT_PLAN from the environment, zeroing all counters.
 void fault_plan_reset();
+
+// CRC32C (Castagnoli), hardware-accelerated when the build has
+// SSE4.2, table-driven otherwise. Incremental: seed with the previous
+// return value to extend a running checksum (crc32c(b, crc32c(a, 0))
+// == crc32c(a||b, 0)).
+uint32_t crc32c(const void *data, size_t len, uint32_t seed);
+
+// Process-wide integrity counters (util.cc): sealed / verified /
+// failed / retransmitted — exported via tdr_seal_counters so tests
+// and the tracer observe the whole detect→retransmit path.
+enum SealCounter {
+  kSealSealed = 0,
+  kSealVerified = 1,
+  kSealFailed = 2,
+  kSealRetx = 3,
+};
+void seal_count(int which);
+uint64_t seal_counter(int which);
+void seal_counters_reset();
+
+// Per-chunk retransmit budget (TDR_SEAL_RETRY, default 3, clamped to
+// [0, 100]): how many NAK-driven re-posts a receiver requests before
+// completing the chunk with TDR_WC_INTEGRITY_ERR.
+int seal_retry_budget();
 
 // Element size for a TDR_DT_*; 0 for unknown.
 size_t dtype_size(int dt);

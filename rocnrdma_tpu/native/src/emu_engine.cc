@@ -29,10 +29,12 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <condition_variable>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
@@ -72,7 +74,35 @@ enum WireOp : uint8_t {
   OP_SEND_FB = 11,
   OP_SEND_FB_DESC = 12,
   OP_SEND_FB_ACK = 13,
+  // Sealed-connection chunk NAK (receiver → sender): land-time seal
+  // verification failed for frame `seq`; re-post it from the
+  // still-live source buffer. The pending op on the sender holds an
+  // inflight MR ref until the final ack, so the source cannot be
+  // reclaimed while retransmissions are possible.
+  OP_NAK = 14,
 };
+
+// Seal: CRC32C over the payload, then extended over the (generation,
+// step, chunk-seq) tag — so a flipped payload byte, a flipped tag, OR
+// a stale-incarnation ghost frame all fail the same verification.
+// Carried after the payload on stream frames and directly after the
+// header on desc frames (the "piggybacked seal frame": desc payloads
+// move via CMA, never the socket).
+#pragma pack(push, 1)
+struct SealTrailer {
+  uint32_t crc;
+  uint32_t gen;   // sender incarnation + 1 (0 = unset, fence skipped)
+  uint32_t step;  // training step (low 32 bits; informational, CRC'd)
+  uint32_t cseq;  // frame sequence (low 32 bits)
+};
+#pragma pack(pop)
+static_assert(sizeof(SealTrailer) == 16, "wire format");
+
+struct FrameHdr;
+// Declared after FrameHdr below: the seal CRC covers the payload, the
+// trailer tag, AND the landing-steering header fields.
+uint32_t seal_crc(const SealTrailer &t, const FrameHdr &h,
+                  const void *data, size_t len);
 
 #pragma pack(push, 1)
 struct FrameHdr {
@@ -87,6 +117,23 @@ struct FrameHdr {
 };
 #pragma pack(pop)
 static_assert(sizeof(FrameHdr) == 40, "wire format");
+
+// Seal CRC material: payload bytes, the trailer tag (gen/step/cseq),
+// then the header fields that STEER the landing (len, raddr) — a
+// flipped length or write address must fail the seal instead of
+// landing intact bytes in the wrong place (the misdirected-WRITE
+// case). The frame sequence is enforced by the explicit
+// t.cseq == h.seq check at verify time; op/status are deliberately
+// uncovered (status legitimately differs between a first transmission
+// and its retransmission).
+uint32_t seal_crc(const SealTrailer &t, const FrameHdr &h,
+                  const void *data, size_t len) {
+  uint32_t c = crc32c(data, len, 0);
+  c = crc32c(&t.gen, 12, c);
+  c = crc32c(&h.len, sizeof(h.len), c);
+  c = crc32c(&h.raddr, sizeof(h.raddr), c);
+  return c;
+}
 
 // Feature bits (FEAT_FOLDBACK / FEAT_FUSED2) and the local_features()
 // advertising helper are shared with the verbs backend — see common.h.
@@ -236,6 +283,23 @@ class EmuEngine : public Engine {
  public:
   int kind() const override { return TDR_ENGINE_EMU; }
   const char *name() const override { return "emu"; }
+
+  // Seal context (tdr_seal_context): stamped into every outbound seal
+  // and compared at land time — the fence that turns a
+  // stale-incarnation ghost write into a detected integrity failure
+  // instead of silently averaged garbage. Engine-scoped (one engine
+  // per rank), not process-wide: in-process multi-rank tests must not
+  // share it.
+  void set_seal_ctx(uint64_t gen_plus1, uint64_t step) override {
+    seal_gen_.store(gen_plus1, std::memory_order_relaxed);
+    seal_step_.store(step, std::memory_order_relaxed);
+  }
+  uint64_t seal_gen() const {
+    return seal_gen_.load(std::memory_order_relaxed);
+  }
+  uint64_t seal_step() const {
+    return seal_step_.load(std::memory_order_relaxed);
+  }
 
   Mr *reg_mr(void *addr, size_t len, int access) override {
     if (!addr || len == 0) {
@@ -411,6 +475,8 @@ class EmuEngine : public Engine {
   // dereg_mr); freed at engine close.
   std::vector<EmuMr *> graveyard_;
   uint32_t next_key_ = 0x1000;
+  std::atomic<uint64_t> seal_gen_{0};
+  std::atomic<uint64_t> seal_step_{0};
 };
 
 struct PendingOp {
@@ -424,6 +490,14 @@ struct PendingOp {
   // completion/flush, so revocation/dereg quiesce across the access;
   // ack-time landings additionally re-validate through it.
   EmuMr *mr = nullptr;
+  // Retransmit state (sealed connections): everything needed to
+  // re-post the wire frame from the still-live source on a NAK. The
+  // inflight ref above is what makes reading `src` safe — an owner
+  // invalidate/dereg blocks until this op's final ack drops it.
+  uint8_t wire_op = 0;
+  const char *src = nullptr;
+  uint64_t raddr = 0;
+  uint32_t rkey = 0;
 };
 
 // RAII pair for EmuEngine::landing_begin: guarantees the inflight ref
@@ -448,6 +522,10 @@ struct PostedRecv {
   // fail the recv, not write reclaimed memory — and (b) trust that
   // the EmuMr object (and its dma-buf mapping) is still alive.
   EmuMr *mr = nullptr;
+  // Posted-order ticket: recv COMPLETIONS are delivered to the CQ in
+  // posted order even when a NAK/retransmit cycle finishes a later
+  // recv first (the ring layers assume FIFO recv completion).
+  uint64_t ticket = 0;
 };
 
 bool EmuMr::quiesce_wait() {
@@ -528,9 +606,10 @@ class EmuQp : public Qp {
     h.raddr = raddr;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(src);
-    h.seq = new_pending(wr_id, TDR_OP_WRITE, nullptr, len, emr);
-    bool ok = cma_ ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
-    if (!ok) return fail_pending(h.seq);
+    h.seq = new_pending(wr_id, TDR_OP_WRITE, nullptr, len, emr, h.op, src,
+                        raddr, rkey);
+    if (!send_frame_sealed(h, src, len, cma_, wr_id))
+      return fail_pending(h.seq);
     return 0;
   }
 
@@ -580,9 +659,10 @@ class EmuQp : public Qp {
     h.op = cma_ ? OP_SEND_DESC : OP_SEND;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(src);
-    h.seq = new_pending(wr_id, TDR_OP_SEND, nullptr, len, emr);
-    bool ok = cma_ ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
-    if (!ok) return fail_pending(h.seq);
+    h.seq = new_pending(wr_id, TDR_OP_SEND, nullptr, len, emr, h.op, src,
+                        0, 0);
+    if (!send_frame_sealed(h, src, len, cma_, wr_id))
+      return fail_pending(h.seq);
     return 0;
   }
 
@@ -626,9 +706,9 @@ class EmuQp : public Qp {
     // re-validated at the ack handler); CMA tier: the receiver's
     // fused kernel writes it directly before acking, made safe by the
     // active inflight ref this post holds until completion.
-    h.seq = new_pending(wr_id, TDR_OP_SEND, src, len, emr);
-    bool ok = cma_ ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
-    if (!ok) return fail_pending(h.seq);
+    h.seq = new_pending(wr_id, TDR_OP_SEND, src, len, emr, h.op, src, 0, 0);
+    if (!send_frame_sealed(h, src, len, cma_, wr_id))
+      return fail_pending(h.seq);
     return 0;
   }
 
@@ -657,6 +737,8 @@ class EmuQp : public Qp {
   }
 
   bool has_recv_reduce() const override { return true; }
+
+  bool has_seal() const override { return seal_; }
 
   int poll(tdr_wc *wc, int max, int timeout_ms) override {
     std::unique_lock<std::mutex> lk(mu_);
@@ -698,6 +780,12 @@ class EmuQp : public Qp {
     uint64_t seq = 0;
     uint64_t src_va = 0;
     uint64_t len = 0;
+    // Sealed connections: the message arrived corrupt with no recv
+    // posted. The entry holds the message's POSITION in the FIFO (so
+    // later messages keep matching later recvs) while its payload
+    // waits for a clean retransmission; a recv that reaches it parks
+    // (parked_) instead of consuming it.
+    bool awaiting_retx = false;
   };
 
   // Drop a consumed recv's MR reference (the last act of every path
@@ -713,15 +801,32 @@ class EmuQp : public Qp {
   // write itself holds an inflight ref so dereg_mr waits it out.
 
   // Common tail of post_recv/post_recv_reduce: consume a buffered
-  // unexpected message if one raced ahead, else enqueue.
+  // unexpected message if one raced ahead, else enqueue. Tickets are
+  // assigned here, in posted order, under the same lock that orders
+  // the match — delivery order == posted order by construction.
   int queue_recv(PostedRecv r) {
     std::unique_lock<std::mutex> lk(mu_);
+    r.ticket = recv_head_++;
     if (!unexpected_.empty()) {
+      if (unexpected_.front().awaiting_retx) {
+        // The front message is a corrupt arrival awaiting its clean
+        // retransmission: this recv is its match — park it (keyed by
+        // the frame seq the retransmission will carry) and drop the
+        // placeholder so later messages keep pairing with later
+        // recvs.
+        parked_[unexpected_.front().seq] = r;
+        unexpected_.pop_front();
+        return 0;
+      }
       Unexpected u = std::move(unexpected_.front());
       unexpected_.pop_front();
       lk.unlock();
       if (!u.fb) {
-        push_wc(deliver_buffer_wc(r, u.payload.data(), u.payload.size()));
+        complete_recv(r.ticket,
+                      deliver_buffer_wc(r, u.payload.data(),
+                                        u.payload.size()));
+      } else if (seal_) {
+        finish_foldback_sealed(r, u);
       } else {
         finish_foldback(r, u);
       }
@@ -754,7 +859,8 @@ class EmuQp : public Qp {
     if (!fold_ok) {
       ack.status = TDR_WC_LOC_ACCESS_ERR;
       sent = send_frame(ack, nullptr, 0);
-      push_wc({r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, u.len});
+      complete_recv(r.ticket,
+                    {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, u.len});
       return sent;
     }
     if (u.desc) {
@@ -770,8 +876,9 @@ class EmuQp : public Qp {
                                 r.red_op);
       ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
       sent = send_frame(ack, nullptr, 0);
-      push_wc({r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
-               TDR_OP_RECV, u.len});
+      complete_recv(r.ticket,
+                    {r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
+                     TDR_OP_RECV, u.len});
       return sent;
     }
     // Stream tier: fold the payload in place (it ends up holding the
@@ -783,8 +890,78 @@ class EmuQp : public Qp {
     ack.status = TDR_WC_SUCCESS;
     ack.len = u.len;
     sent = send_frame(ack, u.payload.data(), u.payload.size());
-    push_wc({r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
+    complete_recv(r.ticket, {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
     return sent;
+  }
+
+  // Sealed foldback delivery: the payload was already VERIFIED (and
+  // always materialized — the one-pass CMA fused kernel would fold
+  // unverified bytes, so seal mode trades it for stage→verify→fold).
+  // The folded result always returns as the ack's payload, itself
+  // sealed; the sender verifies it at the write-back landing.
+  bool finish_foldback_sealed(const PostedRecv &r, Unexpected &u) {
+    fault_landing_delay();
+    FrameHdr ack{};
+    ack.op = OP_SEND_FB_ACK;
+    ack.seq = u.seq;
+    bool fold_ok = r.is_reduce && u.len <= r.maxlen &&
+                   dtype_size(r.dtype) != 0 &&
+                   u.len % dtype_size(r.dtype) == 0 &&
+                   eng_->landing_begin(r.mr);
+    DmaGuard guard{fold_ok ? r.mr : nullptr};
+    (void)guard;
+    if (!fold_ok) {
+      ack.status = TDR_WC_LOC_ACCESS_ERR;
+      bool sent = send_frame(ack, nullptr, 0);
+      complete_recv(r.ticket,
+                    {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, u.len});
+      return sent;
+    }
+    par_reduce2_local(r.dst, u.payload.data(),
+                      u.len / dtype_size(r.dtype), r.dtype, r.red_op);
+    ack.status = TDR_WC_SUCCESS;
+    ack.len = u.len;
+    SealTrailer t{};
+    t.gen = static_cast<uint32_t>(eng_->seal_gen());
+    t.step = static_cast<uint32_t>(eng_->seal_step());
+    t.cseq = static_cast<uint32_t>(ack.seq);
+    t.crc = seal_crc(t, ack, u.payload.data(), u.len);
+    seal_count(kSealSealed);
+    bool sent = send_frame(ack, u.payload.data(), u.payload.size(), &t);
+    complete_recv(r.ticket, {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
+    return sent;
+  }
+
+  // Read the wire trailer and verify `len` landed payload bytes at
+  // `data`. Applies land-site corrupt=N injection BEFORE the verify
+  // ("flip bytes before verify on land"), then checks the CRC and the
+  // incarnation fence. Returns false only on connection loss.
+  bool read_and_verify_trailer(const FrameHdr &h, char *data, uint64_t len,
+                               bool *ok_out) {
+    SealTrailer t{};
+    if (!read_full(fd_, &t, sizeof(t))) return false;
+    long long nb = fault_corrupt("land", static_cast<long long>(h.seq));
+    if (nb > 0 && data && len) {
+      size_t n = std::min<size_t>(static_cast<size_t>(nb),
+                                  static_cast<size_t>(len));
+      for (size_t i = 0; i < n; i++) data[i] ^= static_cast<char>(0xff);
+    }
+    // The CRC covers payload + tag + steering header fields; the
+    // explicit cseq comparison additionally catches a flipped header
+    // seq (which would otherwise route a retransmission to the wrong
+    // parked recv — parked_/retx_attempts_ are keyed by it).
+    bool ok = seal_crc(t, h, data, len) == t.crc &&
+              t.cseq == static_cast<uint32_t>(h.seq);
+    // Incarnation fence: intact bytes stamped by a DIFFERENT live
+    // incarnation are a ghost from a stale world — reject them the
+    // same way as corruption (detected, contained, retry-bounded).
+    uint64_t local = eng_->seal_gen();
+    if (ok && t.gen != 0 && local != 0 &&
+        t.gen != static_cast<uint32_t>(local))
+      ok = false;
+    seal_count(ok ? kSealVerified : kSealFailed);
+    *ok_out = ok;
+    return true;
   }
 
   // Land a payload already in local memory into a posted recv (store
@@ -904,6 +1081,11 @@ class EmuQp : public Qp {
 
     // Wire-changing features require agreement from both ends.
     features_ = mine.features & peer.features;
+    // Sealed framing is wire-changing: only speak it when BOTH ends
+    // advertised it (TDR_NO_SEAL opts out at the advertising stage, so
+    // a mismatched pair degrades to plain frames, never misparses).
+    seal_ = (features_ & FEAT_SEAL) != 0;
+    seal_budget_ = seal_retry_budget();
 
     // Same process is decided by the random token, never by pid (pids
     // are namespace-relative). An unreadable boot_id fails CLOSED:
@@ -937,10 +1119,12 @@ class EmuQp : public Qp {
   // (landing_begin at the post path); ownership passes to the pending
   // entry and is dropped at completion, failure, or flush.
   uint64_t new_pending(uint64_t wr_id, int opcode, char *dst, uint64_t len,
-                       EmuMr *mr) {
+                       EmuMr *mr, uint8_t wire_op = 0,
+                       const char *src = nullptr, uint64_t raddr = 0,
+                       uint32_t rkey = 0) {
     std::lock_guard<std::mutex> g(mu_);
     uint64_t seq = next_seq_++;
-    pending_[seq] = {wr_id, opcode, dst, len, mr};
+    pending_[seq] = {wr_id, opcode, dst, len, mr, wire_op, src, raddr, rkey};
     return seq;
   }
 
@@ -960,11 +1144,69 @@ class EmuQp : public Qp {
     return -1;
   }
 
-  bool send_frame(const FrameHdr &h, const void *payload, size_t len) {
+  bool send_frame(const FrameHdr &h, const void *payload, size_t len,
+                  const SealTrailer *trailer = nullptr) {
     std::lock_guard<std::mutex> g(send_mu_);
-    if (payload && len)
-      return write_hdr_payload(fd_, &h, sizeof(h), payload, len);
-    return write_full(fd_, &h, sizeof(h));
+    if (payload && len) {
+      if (!write_hdr_payload(fd_, &h, sizeof(h), payload, len)) return false;
+    } else {
+      if (!write_full(fd_, &h, sizeof(h))) return false;
+    }
+    if (trailer) return write_full(fd_, trailer, sizeof(*trailer));
+    return true;
+  }
+
+  // Seal-aware frame submission for every payload-bearing request
+  // (SEND-class and WRITE, fresh posts and retransmissions). Computes
+  // the CRC32C + (generation, step, chunk-seq) trailer over the SOURCE
+  // bytes, then applies any matching send-site corrupt=N clause to the
+  // WIRE copy only — the source buffer stays intact so a NAK-driven
+  // retransmission can be clean ("flip bytes after seal on send").
+  // Desc frames carry no payload on the socket, so their injected
+  // corruption flips the CRC instead.
+  bool send_frame_sealed(FrameHdr h, const char *src, size_t len, bool desc,
+                         uint64_t wr_id) {
+    if (!seal_)
+      return desc ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
+    SealTrailer t{};
+    t.gen = static_cast<uint32_t>(eng_->seal_gen());
+    t.step = static_cast<uint32_t>(eng_->seal_step());
+    t.cseq = static_cast<uint32_t>(h.seq);
+    t.crc = seal_crc(t, h, src, len);
+    seal_count(kSealSealed);
+    long long nb = fault_corrupt(
+        "send", static_cast<long long>(wr_id & 0xffffffffffffull));
+    if (nb <= 0)
+      return desc ? send_frame(h, nullptr, 0, &t)
+                  : send_frame(h, src, len, &t);
+    if (desc) {
+      t.crc ^= 0xffffffffu;
+      return send_frame(h, nullptr, 0, &t);
+    }
+    std::vector<char> wire(src, src + len);
+    size_t n = std::min<size_t>(static_cast<size_t>(nb), len);
+    for (size_t i = 0; i < n; i++) wire[i] ^= static_cast<char>(0xff);
+    return send_frame(h, wire.data(), len, &t);
+  }
+
+  // Recv completions reach the CQ in posted-ticket order: a chunk
+  // stuck in a NAK/retransmit cycle holds back the delivery (not the
+  // landing) of later chunks' completions, preserving the FIFO
+  // completion order the ring schedules assert.
+  void complete_recv(uint64_t ticket, tdr_wc wc) {
+    std::lock_guard<std::mutex> g(mu_);
+    recv_done_[ticket] = wc;
+    drain_recv_done_locked();
+    cv_.notify_all();
+  }
+
+  void drain_recv_done_locked() {
+    while (!recv_done_.empty() &&
+           recv_done_.begin()->first == recv_tail_) {
+      cq_.push_back(recv_done_.begin()->second);
+      recv_done_.erase(recv_done_.begin());
+      recv_tail_++;
+    }
   }
 
   void push_wc(tdr_wc wc) {
@@ -1013,7 +1255,7 @@ class EmuQp : public Qp {
       }
       release_recv(r);
       bool sent = send_frame(ack, nullptr, 0);
-      push_wc(wc);
+      complete_recv(r.ticket, wc);
       return sent;
     }
     // Unexpected message: materialize it now. In desc mode the
@@ -1052,9 +1294,11 @@ class EmuQp : public Qp {
     }
     if (have2) {
       if (ok)
-        push_wc(deliver_buffer_wc(r2, buf.data(), buf.size()));
+        complete_recv(r2.ticket,
+                      deliver_buffer_wc(r2, buf.data(), buf.size()));
       else
-        push_wc({r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
+        complete_recv(r2.ticket,
+                      {r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
       release_recv(r2);
     }
     return sent;
@@ -1101,6 +1345,405 @@ class EmuQp : public Qp {
     return true;
   }
 
+  // In-place sealed landing for a claimed plain recv (the fast path
+  // in handle_sealed_inbound): land into r.dst under the MR's
+  // inflight ref, verify there, ack on success; on verify failure
+  // NAK + park the recv for the retransmission, which lands in place
+  // again. Ownership: `r` was popped from recvs_/parked_ by the
+  // caller; every exit either re-parks it or completes + releases it.
+  bool land_sealed_inplace(const FrameHdr &h, bool desc, PostedRecv r) {
+    fault_landing_delay();
+    FrameHdr ack{};
+    ack.op = OP_SEND_ACK;
+    ack.seq = h.seq;
+    if (!eng_->landing_begin(r.mr)) {
+      // Target revoked between post and landing: consume the frame,
+      // fail the recv — the unsealed land paths' error shape (no
+      // retransmit; retrying cannot un-revoke an MR).
+      if (!desc && !drain(h.len)) {
+        release_recv(r);
+        return false;
+      }
+      SealTrailer t{};
+      if (!read_full(fd_, &t, sizeof(t))) {
+        release_recv(r);
+        return false;
+      }
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        retx_attempts_.erase(h.seq);
+      }
+      bool sent = send_frame(ack, nullptr, 0);
+      complete_recv(r.ticket,
+                    {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
+      release_recv(r);
+      return sent;
+    }
+    bool moved = true;
+    bool conn_ok = true;
+    bool verified = false;
+    {
+      // The inflight ref is held across the landing write AND the
+      // verification read of r.dst.
+      DmaGuard guard{r.mr};
+      (void)guard;
+      if (desc) {
+        moved = h.len == 0 ||
+                par_cma_copy_from(peer_pid_, r.dst, h.aux, h.len);
+      } else if (h.len && !read_full(fd_, r.dst, h.len)) {
+        conn_ok = false;
+      }
+      if (conn_ok) {
+        if (!moved) {
+          SealTrailer t{};  // raw: no verify accounting for CMA errors
+          if (!read_full(fd_, &t, sizeof(t))) conn_ok = false;
+        } else if (!read_and_verify_trailer(h, r.dst, h.len, &verified)) {
+          conn_ok = false;
+        }
+      }
+    }
+    if (!conn_ok) {
+      release_recv(r);
+      return false;
+    }
+    if (!moved || verified) {
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        retx_attempts_.erase(h.seq);
+      }
+      ack.status = moved ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
+      bool sent = send_frame(ack, nullptr, 0);
+      complete_recv(r.ticket,
+                    {r.wr_id,
+                     moved ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
+                     TDR_OP_RECV, h.len});
+      release_recv(r);
+      return sent;
+    }
+    int att;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      att = ++retx_attempts_[h.seq];
+      if (att <= seal_budget_) parked_[h.seq] = r;  // keep the recv ref
+      else retx_attempts_.erase(h.seq);
+    }
+    if (att <= seal_budget_) {
+      FrameHdr nak{};
+      nak.op = OP_NAK;
+      nak.seq = h.seq;
+      return send_frame(nak, nullptr, 0);
+    }
+    ack.status = TDR_WC_INTEGRITY_ERR;
+    bool sent = send_frame(ack, nullptr, 0);
+    complete_recv(r.ticket,
+                  {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len});
+    release_recv(r);
+    return sent;
+  }
+
+  // Sealed SEND-class arrival (plain or foldback, stream or desc,
+  // fresh or retransmitted). Reduce and foldback payloads materialize
+  // into a staging buffer first — the seal must be verified before
+  // any byte is folded into an accumulator (the desc tier's one-pass
+  // fused kernels are traded for stage→verify→fold under seal); plain
+  // matched recvs take the in-place fast path above instead. Then:
+  //   verified    → land into the parked/FIFO recv, or buffer it;
+  //   corrupt     → NAK the chunk seq back to the sender (bounded
+  //                 per-chunk budget). A matched recv PARKS (keyed by
+  //                 seq) so later messages keep pairing with later
+  //                 recvs; an unmatched corrupt message leaves an
+  //                 awaiting_retx placeholder holding its FIFO slot.
+  //   budget out  → the recv completes TDR_WC_INTEGRITY_ERR and the
+  //                 ack carries the same status to the sender.
+  bool handle_sealed_inbound(const FrameHdr &h, bool desc, bool fb) {
+    const bool retx = h.status == 1;
+    if (h.len > kMaxUnexpectedBytes) return false;
+
+    // Fast path: a PLAIN (non-reduce) recv is already posted (or
+    // parked awaiting this retransmission) and large enough — land
+    // directly into its buffer and verify IN PLACE, like writes: no
+    // staging allocation or extra copy on the sealed hot path. A
+    // verify failure leaves the recv parked with undefined contents
+    // (the WR has not completed) until a clean retransmission
+    // overwrites them. Reduce recvs never take this path — a fold is
+    // destructive, so they must stage→verify→fold.
+    if (!fb) {
+      PostedRecv r{};
+      bool claim = false;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        if (retx) {
+          auto it = parked_.find(h.seq);
+          if (it != parked_.end() && !it->second.is_reduce &&
+              h.len <= it->second.maxlen) {
+            r = it->second;
+            parked_.erase(it);
+            claim = true;
+          }
+        } else if (unexpected_.empty() && !recvs_.empty() &&
+                   !recvs_.front().is_reduce &&
+                   h.len <= recvs_.front().maxlen) {
+          r = recvs_.front();
+          recvs_.pop_front();
+          claim = true;
+        }
+      }
+      if (claim) return land_sealed_inplace(h, desc, r);
+    }
+
+    std::vector<char> buf(h.len);
+    bool moved;
+    if (desc) {
+      moved = h.len == 0 ||
+              par_cma_copy_from(peer_pid_, buf.data(), h.aux, h.len);
+    } else {
+      if (h.len && !read_full(fd_, buf.data(), h.len)) return false;
+      moved = true;
+    }
+    bool verified = false;
+    if (!moved) {
+      // CMA failure, not corruption: consume the trailer RAW —
+      // verification accounting and land-site corruption injection
+      // must not run against a payload that never materialized, or
+      // integrity.failed / clause hit counters would report a
+      // corruption that never happened.
+      SealTrailer t{};
+      if (!read_full(fd_, &t, sizeof(t))) return false;
+    } else if (!read_and_verify_trailer(h, buf.data(), h.len, &verified)) {
+      return false;
+    }
+
+    FrameHdr ack{};
+    ack.op = fb ? OP_SEND_FB_ACK : OP_SEND_ACK;
+    ack.seq = h.seq;
+
+    if (!moved) {
+      // No retransmit can fix a CMA failure — the unsealed desc
+      // path's error shape.
+      PostedRecv r{};
+      bool have = false;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = parked_.find(h.seq);
+        if (retx && it != parked_.end()) {
+          r = it->second;
+          have = true;
+          parked_.erase(it);
+        } else if (!retx && !recvs_.empty()) {
+          r = recvs_.front();
+          recvs_.pop_front();
+          have = true;
+        }
+        // An awaiting placeholder for this seq is dead: the sender
+        // completes with the error ack below and will never
+        // retransmit — leaving it would park the next posted recv
+        // forever and wedge every later completion behind its ticket.
+        for (auto uit = unexpected_.begin(); uit != unexpected_.end();
+             ++uit)
+          if (uit->awaiting_retx && uit->seq == h.seq) {
+            unexpected_.erase(uit);
+            break;
+          }
+        retx_attempts_.erase(h.seq);
+      }
+      ack.status = TDR_WC_GENERAL_ERR;
+      bool sent = send_frame(ack, nullptr, 0);
+      if (have) {
+        complete_recv(r.ticket,
+                      {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
+        release_recv(r);
+      }
+      return sent;
+    }
+
+    // Route under ONE lock with the recv FIFO so a recv posted while
+    // the payload was in flight either matched here or sees the
+    // buffered/placeholder entry — never both stranded.
+    PostedRecv r{};
+    bool have = false, was_parked = false, send_nak = false,
+         give_up = false, ack_now = false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      Unexpected *ph = nullptr;
+      if (retx) {
+        auto it = parked_.find(h.seq);
+        if (it != parked_.end()) {
+          r = it->second;
+          have = true;
+          was_parked = true;
+        } else {
+          for (auto &u : unexpected_)
+            if (u.awaiting_retx && u.seq == h.seq) {
+              ph = &u;
+              break;
+            }
+          if (!ph) return true;  // already given up / flushed: drop
+        }
+      } else if (!recvs_.empty()) {
+        r = recvs_.front();
+        recvs_.pop_front();
+        have = true;
+      }
+
+      if (verified) {
+        retx_attempts_.erase(h.seq);
+        if (was_parked) parked_.erase(h.seq);
+        if (!have) {
+          if (ph) {
+            ph->payload = std::move(buf);
+            ph->len = h.len;
+            ph->fb = fb;
+            ph->awaiting_retx = false;
+          } else {
+            Unexpected u;
+            u.fb = fb;
+            u.seq = h.seq;
+            u.len = h.len;
+            u.payload = std::move(buf);
+            unexpected_.push_back(std::move(u));
+          }
+          // Plain sends ack at materialization (the sender's buffer
+          // is only promised stable until its completion); foldback
+          // acks MUST wait for the fold.
+          ack_now = !fb;
+        }
+      } else {
+        int att = ++retx_attempts_[h.seq];
+        if (att <= seal_budget_) {
+          send_nak = true;
+          if (have && !was_parked) parked_[h.seq] = r;
+          if (!have && !ph) {
+            Unexpected u;
+            u.fb = fb;
+            u.seq = h.seq;
+            u.len = h.len;
+            u.awaiting_retx = true;
+            unexpected_.push_back(std::move(u));
+          }
+        } else {
+          give_up = true;
+          retx_attempts_.erase(h.seq);
+          if (was_parked) parked_.erase(h.seq);
+          if (ph) {
+            for (auto it = unexpected_.begin(); it != unexpected_.end();
+                 ++it)
+              if (it->awaiting_retx && it->seq == h.seq) {
+                unexpected_.erase(it);
+                break;
+              }
+          }
+        }
+      }
+    }
+
+    if (verified && have) {
+      if (fb) {
+        Unexpected u;
+        u.fb = true;
+        u.seq = h.seq;
+        u.len = h.len;
+        u.payload = std::move(buf);
+        bool sent = finish_foldback_sealed(r, u);
+        release_recv(r);
+        return sent;
+      }
+      tdr_wc wc = deliver_buffer_wc(r, buf.data(), h.len);
+      ack.status = TDR_WC_SUCCESS;
+      bool sent = send_frame(ack, nullptr, 0);
+      complete_recv(r.ticket, wc);
+      release_recv(r);
+      return sent;
+    }
+    if (ack_now) {
+      ack.status = TDR_WC_SUCCESS;
+      return send_frame(ack, nullptr, 0);
+    }
+    if (send_nak) {
+      FrameHdr nak{};
+      nak.op = OP_NAK;
+      nak.seq = h.seq;
+      return send_frame(nak, nullptr, 0);
+    }
+    if (give_up) {
+      ack.status = TDR_WC_INTEGRITY_ERR;
+      bool sent = send_frame(ack, nullptr, 0);
+      if (have) {
+        complete_recv(r.ticket,
+                      {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len});
+        release_recv(r);
+      }
+      return sent;
+    }
+    return true;  // verified foldback buffered: ack comes at fold time
+  }
+
+  // Sealed OP_WRITE / OP_WRITE_DESC: land in place, verify, ack — or
+  // NAK for a bounded retransmit. Landing before verifying is safe
+  // for writes (nothing is folded): the WR has not completed, its
+  // target's contents are undefined until it does, and a clean
+  // retransmission overwrites the rejected bytes.
+  bool handle_sealed_write(const FrameHdr &h, bool desc) {
+    EmuMr *tmr = nullptr;
+    char *dst = eng_->resolve(h.rkey, h.raddr, h.len,
+                              TDR_ACCESS_REMOTE_WRITE, &tmr);
+    FrameHdr ack{};
+    ack.op = OP_WRITE_ACK;
+    ack.seq = h.seq;
+    if (!dst) {
+      if (!desc && !drain(h.len)) return false;
+      SealTrailer t{};
+      if (!read_full(fd_, &t, sizeof(t))) return false;
+      ack.status = TDR_WC_REM_ACCESS_ERR;
+      return send_frame(ack, nullptr, 0);
+    }
+    bool moved;
+    if (desc) {
+      moved = par_cma_copy_from(peer_pid_, dst, h.aux, h.len);
+    } else {
+      if (!read_full(fd_, dst, h.len)) {
+        EmuEngine::dma_done(tmr);
+        return false;
+      }
+      moved = true;
+    }
+    if (!moved) {
+      EmuEngine::dma_done(tmr);
+      SealTrailer t{};
+      if (!read_full(fd_, &t, sizeof(t))) return false;
+      ack.status = TDR_WC_GENERAL_ERR;
+      return send_frame(ack, nullptr, 0);
+    }
+    // Verification reads the landed region, so the inflight ref is
+    // held across it — the owner cannot reclaim the pages mid-check.
+    bool verified = false;
+    bool alive = read_and_verify_trailer(h, dst, h.len, &verified);
+    EmuEngine::dma_done(tmr);
+    if (!alive) return false;
+    if (verified) {
+      std::lock_guard<std::mutex> g(mu_);
+      retx_attempts_.erase(h.seq);
+      ack.status = TDR_WC_SUCCESS;
+    } else {
+      int att;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        att = ++retx_attempts_[h.seq];
+      }
+      if (att <= seal_budget_) {
+        FrameHdr nak{};
+        nak.op = OP_NAK;
+        nak.seq = h.seq;
+        return send_frame(nak, nullptr, 0);
+      }
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        retx_attempts_.erase(h.seq);
+      }
+      ack.status = TDR_WC_INTEGRITY_ERR;
+    }
+    return send_frame(ack, nullptr, 0);
+  }
+
   // Drain len payload bytes we cannot place (bad rkey etc.).
   bool drain(uint64_t len) {
     char scratch[65536];
@@ -1117,6 +1760,10 @@ class EmuQp : public Qp {
     while (read_full(fd_, &h, sizeof(h))) {
       switch (h.op) {
         case OP_WRITE: {
+          if (seal_) {
+            if (!handle_sealed_write(h, /*desc=*/false)) goto out;
+            break;
+          }
           EmuMr *tmr = nullptr;
           char *dst = eng_->resolve(h.rkey, h.raddr, h.len,
                                     TDR_ACCESS_REMOTE_WRITE, &tmr);
@@ -1156,6 +1803,11 @@ class EmuQp : public Qp {
           break;
         }
         case OP_SEND: {
+          if (seal_) {
+            if (!handle_sealed_inbound(h, /*desc=*/false, /*fb=*/false))
+              goto out;
+            break;
+          }
           if (!handle_send_inbound(h, /*desc=*/false)) goto out;
           break;
         }
@@ -1163,6 +1815,10 @@ class EmuQp : public Qp {
           // Desc ops are only valid after both sides negotiated the
           // CMA tier; peer_pid_ is meaningless otherwise.
           if (!cma_) goto out;
+          if (seal_) {
+            if (!handle_sealed_write(h, /*desc=*/true)) goto out;
+            break;
+          }
           EmuMr *tmr = nullptr;
           char *dst = eng_->resolve(h.rkey, h.raddr, h.len,
                                     TDR_ACCESS_REMOTE_WRITE, &tmr);
@@ -1204,16 +1860,66 @@ class EmuQp : public Qp {
         }
         case OP_SEND_DESC: {
           if (!cma_) goto out;
+          if (seal_) {
+            if (!handle_sealed_inbound(h, /*desc=*/true, /*fb=*/false))
+              goto out;
+            break;
+          }
           if (!handle_send_inbound(h, /*desc=*/true)) goto out;
           break;
         }
         case OP_SEND_FB: {
+          if (seal_) {
+            if (!handle_sealed_inbound(h, /*desc=*/false, /*fb=*/true))
+              goto out;
+            break;
+          }
           if (!handle_foldback_inbound(h, /*desc=*/false)) goto out;
           break;
         }
         case OP_SEND_FB_DESC: {
           if (!cma_) goto out;
+          if (seal_) {
+            if (!handle_sealed_inbound(h, /*desc=*/true, /*fb=*/true))
+              goto out;
+            break;
+          }
           if (!handle_foldback_inbound(h, /*desc=*/true)) goto out;
+          break;
+        }
+        case OP_NAK: {
+          // Peer's land-time verification failed for frame `seq`:
+          // re-post it from the still-live source (the pending op's
+          // inflight MR ref holds revocation off until the final
+          // ack). Retransmissions re-run the send-site fault walk, so
+          // an always-corrupt clause keeps corrupting them — that is
+          // how the budget boundary is tested deterministically.
+          PendingOp p{};
+          bool have = false;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            auto it = pending_.find(h.seq);
+            if (it != pending_.end() && it->second.src) {
+              p = it->second;
+              have = true;
+            }
+          }
+          if (have) {
+            seal_count(kSealRetx);
+            FrameHdr rh{};
+            rh.op = p.wire_op;
+            rh.status = 1;  // retransmission marker
+            rh.seq = h.seq;
+            rh.rkey = p.rkey;
+            rh.raddr = p.raddr;
+            rh.len = p.len;
+            rh.aux = reinterpret_cast<uint64_t>(p.src);
+            bool desc = p.wire_op == OP_WRITE_DESC ||
+                        p.wire_op == OP_SEND_DESC ||
+                        p.wire_op == OP_SEND_FB_DESC;
+            if (!send_frame_sealed(rh, p.src, p.len, desc, p.wr_id))
+              goto out;
+          }
           break;
         }
         case OP_SEND_FB_ACK: {
@@ -1241,10 +1947,24 @@ class EmuQp : public Qp {
                        eng_->landing_begin(pmr);
             if (can) {
               bool ok = read_full(fd_, dst, h.len);
+              if (ok && seal_) {
+                // The write-back is a landing too: verify the folded
+                // bytes before the exchange completes. No retransmit
+                // for this direction (the fold already consumed the
+                // forward payload) — failure surfaces as an integrity
+                // completion and the elastic ladder takes it.
+                bool vok = false;
+                ok = read_and_verify_trailer(h, dst, h.len, &vok);
+                if (ok && !vok) st = TDR_WC_INTEGRITY_ERR;
+              }
               EmuEngine::dma_done(pmr);
               if (!ok) goto out;
             } else {
               if (!drain(h.len)) goto out;
+              if (seal_) {
+                SealTrailer t{};
+                if (!read_full(fd_, &t, sizeof(t))) goto out;
+              }
               if (st == TDR_WC_SUCCESS) st = TDR_WC_LOC_ACCESS_ERR;
             }
           }
@@ -1292,7 +2012,9 @@ class EmuQp : public Qp {
     }
   out:
     // Connection gone: flush every in-flight op and pending recv, the
-    // RC flush semantics (TDR_WC_FLUSH_ERR).
+    // RC flush semantics (TDR_WC_FLUSH_ERR). Recv flushes route
+    // through the ticket map so completions withheld behind a parked
+    // (retransmit-pending) chunk drain in posted order.
     std::lock_guard<std::mutex> g(mu_);
     dead_ = true;
     for (auto &kv : pending_) {
@@ -1301,10 +2023,18 @@ class EmuQp : public Qp {
     }
     pending_.clear();
     for (auto &r : recvs_) {
-      cq_.push_back({r.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0});
+      recv_done_[r.ticket] = {r.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
       release_recv(r);
     }
     recvs_.clear();
+    for (auto &kv : parked_) {
+      recv_done_[kv.second.ticket] =
+          {kv.second.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
+      release_recv(kv.second);
+    }
+    parked_.clear();
+    retx_attempts_.clear();
+    drain_recv_done_locked();
     cv_.notify_all();
   }
 
@@ -1329,15 +2059,28 @@ class EmuQp : public Qp {
   pid_t peer_pid_ = -1;
   uint64_t probe_val_ = 0;
   uint32_t features_ = 0;
+  // Sealed framing (FEAT_SEAL negotiated) and the per-chunk
+  // retransmit budget, both fixed at handshake time.
+  bool seal_ = false;
+  int seal_budget_ = 3;
 
   std::mutex send_mu_;  // serializes frame submission on the socket
 
-  std::mutex mu_;  // guards cq_, pending_, recvs_, unexpected_
+  std::mutex mu_;  // guards cq_, pending_, recvs_, unexpected_,
+                   // parked_, retx_attempts_, and the ticket state
   std::condition_variable cv_;
   std::deque<tdr_wc> cq_;
   std::unordered_map<uint64_t, PendingOp> pending_;
   std::deque<PostedRecv> recvs_;
   std::deque<Unexpected> unexpected_;
+  // Sealed-connection retransmit state: recvs parked for a
+  // retransmission (keyed by frame seq) and per-seq attempt counts.
+  std::unordered_map<uint64_t, PostedRecv> parked_;
+  std::unordered_map<uint64_t, int> retx_attempts_;
+  // Posted-order recv completion delivery (see complete_recv).
+  uint64_t recv_head_ = 0;
+  uint64_t recv_tail_ = 0;
+  std::map<uint64_t, tdr_wc> recv_done_;
   uint64_t next_seq_ = 1;
   bool dead_ = false;
 };

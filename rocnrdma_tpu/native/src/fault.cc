@@ -14,7 +14,8 @@
 //   clause         := site[:match...]:action
 //   site           := send | conn | land | ring
 //   match          := chunk=K     (send: ring chunk index — the low
-//                                  48 bits of the wr_id)
+//                                  48 bits of the wr_id; land corrupt
+//                                  clauses match the frame sequence)
 //                     nth=N       (fire on the Nth matching arrival at
 //                                  the site, 1-based, process-wide)
 //   action         := once=STATUS   (send/ring only: inject STATUS
@@ -25,6 +26,12 @@
 //                     drop_after=N  (conn only: the first N posts go
 //                                    through, the next one finds the
 //                                    connection dead)
+//                     corrupt=N     (send/land only, sealed
+//                                    connections: flip N payload
+//                                    bytes after sealing on send /
+//                                    before verification on land;
+//                                    fires on every match — combine
+//                                    with nth=K for single-shot)
 //   Clauses whose action the site cannot apply are rejected at parse
 //   time (a counted-but-unapplied injection would be a lie).
 //   STATUS         := general_err | rem_access_err | loc_access_err |
@@ -73,6 +80,7 @@ struct FaultClause {
   long long nth = -1;         // match: Nth arrival (1-based)
   long long drop_after = -1;  // conn: posts that survive
   long long stall_ms = 0;
+  long long corrupt = -1;     // send/land: payload bytes to flip
   bool once = false;
   int status = -1;  // TDR_WC_* to inject
   // Runtime state (guarded by g_mu).
@@ -134,6 +142,8 @@ bool parse_clause(const std::string &text, FaultClause *c) {
       if (!parse_ll(val, &c->drop_after) || c->drop_after < 0) return false;
     } else if (key == "stall_ms") {
       if (!parse_ll(val, &c->stall_ms) || c->stall_ms < 0) return false;
+    } else if (key == "corrupt") {
+      if (!parse_ll(val, &c->corrupt) || c->corrupt < 1) return false;
     } else if (key == "once" || key == "always") {
       c->status = status_by_name(val);
       if (c->status < 0) return false;
@@ -151,8 +161,15 @@ bool parse_clause(const std::string &text, FaultClause *c) {
   if (c->status >= 0 && c->site != "send" && c->site != "ring")
     return false;
   if (c->drop_after >= 0 && c->site != "conn") return false;
+  // corrupt flips payload bytes — only sites that carry a payload can
+  // apply it, and a clause mixing it with a status injection would
+  // make either counter a half-truth.
+  if (c->corrupt >= 0 &&
+      (c->site == "conn" || c->site == "ring" || c->status >= 0))
+    return false;
   // A clause must DO something.
-  return c->status >= 0 || c->stall_ms > 0 || c->drop_after >= 0;
+  return c->status >= 0 || c->stall_ms > 0 || c->drop_after >= 0 ||
+         c->corrupt >= 1;
 }
 
 void parse_locked() {
@@ -197,6 +214,10 @@ int fault_point(const char *site, long long chunk) {
   {
     std::lock_guard<std::mutex> g(g_mu);
     for (auto &c : g_clauses) {
+      // Corrupt clauses are evaluated exclusively by fault_corrupt
+      // (at frame-transmission / payload-landing time); visiting them
+      // here would double-count their arrivals.
+      if (c.corrupt >= 0) continue;
       if (c.site != site) continue;
       if (c.chunk >= 0 && chunk != c.chunk) continue;
       c.seen++;
@@ -221,6 +242,29 @@ int fault_point(const char *site, long long chunk) {
   if (stall > 0)
     std::this_thread::sleep_for(std::chrono::milliseconds(stall));
   return inject;
+}
+
+long long fault_corrupt(const char *site, long long chunk) {
+  ensure_parsed();
+  if (!g_active.load(std::memory_order_acquire)) return 0;
+  long long stall = 0;
+  long long nbytes = 0;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    for (auto &c : g_clauses) {
+      if (c.corrupt < 1) continue;  // the corrupt-only pass
+      if (c.site != site) continue;
+      if (c.chunk >= 0 && chunk != c.chunk) continue;
+      c.seen++;
+      if (c.nth >= 1 && static_cast<long long>(c.seen) != c.nth) continue;
+      c.hits++;
+      stall += c.stall_ms;
+      if (nbytes == 0) nbytes = c.corrupt;
+    }
+  }
+  if (stall > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(stall));
+  return nbytes;
 }
 
 void fault_land_delay() {

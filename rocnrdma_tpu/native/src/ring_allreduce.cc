@@ -56,6 +56,33 @@ using tdr::dtype_size;
 using tdr::reduce_any;
 using tdr::ring_timeout_ms;
 
+// Human-readable WC status for the completion-error messages: the
+// Python taxonomy keys off both the numeric status and message
+// markers, and "integrity" must be visible to operators without a
+// decoder ring.
+const char *wc_status_name(int st) {
+  switch (st) {
+    case TDR_WC_SUCCESS:
+      return "success";
+    case TDR_WC_REM_ACCESS_ERR:
+      return "rem_access_err";
+    case TDR_WC_LOC_ACCESS_ERR:
+      return "loc_access_err";
+    case TDR_WC_FLUSH_ERR:
+      return "flush_err";
+    case TDR_WC_GENERAL_ERR:
+      return "general_err";
+    case TDR_WC_INTEGRITY_ERR:
+      return "integrity_err";
+    default:
+      return "unknown";
+  }
+}
+
+std::string wc_status_label(int st) {
+  return std::to_string(st) + " (" + wc_status_name(st) + ")";
+}
+
 // wr_id tags for the pipeline: high 16 bits the kind, low bits the
 // chunk index, so one poll loop can route recv completions (in posted
 // order) and send acks (order-independent, only counted).
@@ -291,7 +318,7 @@ struct StepPipe {
       for (int i = 0; i < n; i++) {
         if (wc[i].status != TDR_WC_SUCCESS) {
           tdr::set_error("ring: completion error status " +
-                         std::to_string(wc[i].status));
+                         wc_status_label(wc[i].status));
           return -1;
         }
         uint64_t kind = wc[i].wr_id & kWrKindMask;
@@ -432,7 +459,7 @@ struct FusedTwo {
     for (int i = 0; i < n; i++) {
       if (wc[i].status != TDR_WC_SUCCESS) {
         tdr::set_error("ring(fused2): completion error status " +
-                       std::to_string(wc[i].status));
+                       wc_status_label(wc[i].status));
         return -1;
       }
       uint64_t kind = wc[i].wr_id & kWrKindMask;
@@ -596,7 +623,7 @@ struct Wavefront {
     for (int i = 0; i < n; i++) {
       if (wc[i].status != TDR_WC_SUCCESS) {
         tdr::set_error("ring(wave): completion error status " +
-                       std::to_string(wc[i].status));
+                       wc_status_label(wc[i].status));
         return -1;
       }
       uint64_t kind = wc[i].wr_id & kWrKindMask;
@@ -995,7 +1022,7 @@ struct ChainPump {
       for (int i = 0; i < c; i++) {
         if (wc[i].status != TDR_WC_SUCCESS) {
           tdr::set_error(std::string(label) + ": completion error status " +
-                         std::to_string(wc[i].status));
+                         wc_status_label(wc[i].status));
           return -1;
         }
         uint64_t kind = wc[i].wr_id & kWrKindMask;

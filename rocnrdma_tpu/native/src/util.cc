@@ -9,6 +9,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <chrono>
 #include <cstdlib>
 #include <thread>
@@ -41,7 +42,88 @@ uint32_t local_features() {
   if (!env_set("TDR_NO_FOLDBACK") && !env_set("TDR_NO_FUSED2"))
     f |= FEAT_FOLDBACK;
   if (!env_set("TDR_NO_FUSED2")) f |= FEAT_FUSED2;
+  if (!env_set("TDR_NO_SEAL")) f |= FEAT_SEAL;
   return f;
+}
+
+int seal_retry_budget() {
+  const char *env = getenv("TDR_SEAL_RETRY");
+  if (env && *env) {
+    long long v = atoll(env);
+    if (v >= 0 && v <= 100) return static_cast<int>(v);
+  }
+  return 3;
+}
+
+// ------------------------------------------------------------------
+// CRC32C — the seal's checksum. Hardware path rides the SSE4.2 crc32
+// instruction when the build enables it (TUNE=native does on any
+// modern x86); the software path is a standard reflected-0x82F63B78
+// byte table, bit-identical to the hardware result.
+
+#if !defined(__SSE4_2__)
+namespace {
+
+const uint32_t *crc32c_table() {
+  static const uint32_t *table = [] {
+    static uint32_t t[256];
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+      t[i] = c;
+    }
+    return t;
+  }();
+  return table;
+}
+
+}  // namespace
+#endif
+
+uint32_t crc32c(const void *data, size_t len, uint32_t seed) {
+  const unsigned char *p = static_cast<const unsigned char *>(data);
+  uint32_t crc = ~seed;
+#if defined(__SSE4_2__)
+  while (len >= 8) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    crc = static_cast<uint32_t>(__builtin_ia32_crc32di(crc, v));
+    p += 8;
+    len -= 8;
+  }
+  while (len > 0) {
+    crc = __builtin_ia32_crc32qi(crc, *p++);
+    len--;
+  }
+#else
+  const uint32_t *t = crc32c_table();
+  while (len > 0) {
+    crc = t[(crc ^ *p++) & 0xff] ^ (crc >> 8);
+    len--;
+  }
+#endif
+  return ~crc;
+}
+
+// Integrity counters: process-wide like the fault-plan counters (all
+// QPs share them), so a test can assert the whole detect→retransmit
+// path fired without threading handles around.
+static std::atomic<uint64_t> g_seal_counters[4];
+
+void seal_count(int which) {
+  if (which >= 0 && which < 4)
+    g_seal_counters[which].fetch_add(1, std::memory_order_relaxed);
+}
+
+uint64_t seal_counter(int which) {
+  return (which >= 0 && which < 4)
+             ? g_seal_counters[which].load(std::memory_order_relaxed)
+             : 0;
+}
+
+void seal_counters_reset() {
+  for (auto &c : g_seal_counters) c.store(0, std::memory_order_relaxed);
 }
 
 size_t dtype_size(int dt) {

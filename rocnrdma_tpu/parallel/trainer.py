@@ -67,12 +67,30 @@ class ElasticPolicy:
     ``trainer.global_step``. ``max_resumes``: resume budget PER STEP
     before the error propagates. ``rebuild``: kwargs forwarded to
     ``RingWorld.rebuild`` (retry budget, backoff, per-attempt
-    deadline)."""
+    deadline).
+
+    ``quarantine_nonfinite``: the last rung below the elastic ladder.
+    A step whose all-reduced gradients VERIFY (the transport seal
+    caught no corruption) but come back non-finite is retried ONCE
+    from the pre-step state — params/optimizer are untouched because
+    apply never ran, so the retry recomputes and re-syncs the same
+    batch in place. Only if the retry is ALSO non-finite does the
+    elastic path engage (rebuild → restore → re-run), on the theory
+    that a deterministic non-finite loss would have been non-finite
+    the first time: a once-only non-finite is transport-shaped, not
+    data-shaped."""
 
     checkpoint_path: str
     save_every: int = 1
     max_resumes: int = 4
     rebuild: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    quarantine_nonfinite: bool = True
+
+
+class _NonFiniteGrads(RuntimeError):
+    """Internal: the all-reduced gradients verified but are non-finite
+    (NaN/inf). Raised from the post-sync check so ``step()`` can run
+    the quarantine retry before the elastic ladder engages."""
 
 
 class Trainer:
@@ -268,9 +286,40 @@ class Trainer:
                 # over the RDMA transport (staged fallback accounts
                 # its bytes), then applied locally.
                 grads = self.cross_slice_sync(grads)
+                # Quarantine check BEFORE apply: gradients that passed
+                # the transport's integrity seal but came back
+                # non-finite would poison params on apply — with the
+                # elastic policy armed, surface them while the
+                # pre-step state is still intact (step() retries once
+                # in place, then escalates).
+                if (self.elastic is not None
+                        and self.elastic.quarantine_nonfinite
+                        and not self._grads_finite(grads)):
+                    raise _NonFiniteGrads(
+                        f"all-reduced gradients contain non-finite "
+                        f"values at step {self.global_step + 1}")
                 self.params, self.opt_state = self._jit_apply(
                     self.params, self.opt_state, grads)
         return float(loss)
+
+    @staticmethod
+    def _grads_finite(grads) -> bool:
+        import numpy as np
+
+        for leaf in jax.tree_util.tree_leaves(grads):
+            try:
+                if isinstance(leaf, np.ndarray):
+                    ok = bool(np.all(np.isfinite(leaf)))
+                else:
+                    # Device leaf: reduce ON DEVICE and transfer one
+                    # scalar — this runs every elastic step, so it
+                    # must never copy the gradient itself to host.
+                    ok = bool(jnp.all(jnp.isfinite(leaf)))
+            except TypeError:
+                continue  # non-float dtype with no isfinite: trivially ok
+            if not ok:
+                return False
+        return True
 
     def _resume(self, exc: BaseException, attempt: int) -> None:
         """The detect→recover bridge: rebuild the transport world under
@@ -314,24 +363,46 @@ class Trainer:
     def step(self, tokens) -> float:
         """One optimizer step; returns the (pre-update) loss. With an
         ``elastic=`` policy, retryable transport failures mid-step
-        trigger rebuild→restore→re-run (bounded by ``max_resumes``);
-        successful steps checkpoint every ``save_every`` steps."""
+        trigger rebuild→restore→re-run (bounded by ``max_resumes``),
+        and verified-but-non-finite gradients are quarantined: retried
+        once in place from the pre-step state (apply never ran) before
+        the elastic ladder engages. Successful steps checkpoint every
+        ``save_every`` steps."""
         if self.elastic is None:
             loss = self._step_once(tokens)
         else:
             from rocnrdma_tpu.transport.engine import TransportError
 
             resumes = 0
+            quarantined = False
             while True:
                 try:
                     loss = self._step_once(tokens)
                     break
+                except _NonFiniteGrads as e:
+                    if not quarantined:
+                        # First non-finite on this step: retry in
+                        # place. Params/opt_state are the pre-step
+                        # values (apply never ran), so the re-run
+                        # recomputes and re-syncs the same batch.
+                        quarantined = True
+                        trace.event("trainer.quarantine",
+                                    step=self.global_step + 1)
+                        continue
+                    # The retry was ALSO non-finite: escalate to the
+                    # elastic path (rebuild → restore → re-run).
+                    if resumes >= self.elastic.max_resumes:
+                        raise TransportError(str(e), retryable=True)
+                    resumes += 1
+                    self._resume(e, resumes)
+                    quarantined = False
                 except TransportError as e:
                     if (not getattr(e, "retryable", False)
                             or resumes >= self.elastic.max_resumes):
                         raise
                     resumes += 1
                     self._resume(e, resumes)
+                    quarantined = False
         self.global_step += 1
         if (self.elastic is not None and self.elastic.save_every > 0
                 and self.global_step % self.elastic.save_every == 0):

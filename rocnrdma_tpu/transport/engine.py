@@ -21,7 +21,11 @@ from typing import List, Optional, Sequence, Tuple
 from rocnrdma_tpu.utils.trace import trace
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
-_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libtdr.so"))
+# TDR_NATIVE_LIB points at an alternative artifact (the sanitized
+# libtdr_san.so built by `make sanitize`); default is the on-demand
+# production build.
+_LIB_PATH = os.environ.get("TDR_NATIVE_LIB") or os.path.abspath(
+    os.path.join(_NATIVE_DIR, "libtdr.so"))
 _build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 
@@ -35,6 +39,9 @@ WC_REM_ACCESS_ERR = 1
 WC_LOC_ACCESS_ERR = 2
 WC_FLUSH_ERR = 3
 WC_GENERAL_ERR = 4
+# Seal verification failed at land time and the per-chunk retransmit
+# budget is exhausted (or a stale-incarnation frame was fenced).
+WC_INTEGRITY_ERR = 5
 
 # Access flags
 ACCESS_LOCAL = 0
@@ -137,6 +144,16 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_fault_plan_seen.restype = ctypes.c_uint64
     lib.tdr_fault_plan_seen.argtypes = [ctypes.c_int]
     lib.tdr_fault_plan_reset.restype = None
+    lib.tdr_crc32c.restype = ctypes.c_uint32
+    lib.tdr_crc32c.argtypes = [P, ctypes.c_size_t, ctypes.c_uint32]
+    lib.tdr_seal_counters.restype = None
+    lib.tdr_seal_counters.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+    lib.tdr_seal_counters_reset.restype = None
+    lib.tdr_seal_retry_budget.restype = ctypes.c_int
+    lib.tdr_seal_context.restype = None
+    lib.tdr_seal_context.argtypes = [P, ctypes.c_uint64, ctypes.c_uint64]
+    lib.tdr_qp_has_seal.restype = ctypes.c_int
+    lib.tdr_qp_has_seal.argtypes = [P]
     lib.tdr_connect.restype = P
     lib.tdr_connect.argtypes = [P, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.tdr_qp_close.argtypes = [P]
@@ -203,10 +220,14 @@ def _declare(lib: ctypes.CDLL) -> None:
 
 
 # Completion statuses that signal a TRANSIENT condition — a peer died
-# or a connection dropped (flush), or a wedge/injected fault (general):
-# the world can be rebuilt and the operation retried. Access errors
-# (REM/LOC) are lifetime/programming bugs; retrying cannot fix them.
-_RETRYABLE_STATUSES = frozenset({WC_FLUSH_ERR, WC_GENERAL_ERR})
+# or a connection dropped (flush), a wedge/injected fault (general),
+# or detected-and-uncorrectable payload corruption (integrity — the
+# per-chunk retransmit budget already failed to heal it, so the next
+# rung of the ladder is a world rebuild): the world can be rebuilt and
+# the operation retried. Access errors (REM/LOC) are
+# lifetime/programming bugs; retrying cannot fix them.
+_RETRYABLE_STATUSES = frozenset({WC_FLUSH_ERR, WC_GENERAL_ERR,
+                                 WC_INTEGRITY_ERR})
 _WC_STATUS_RE = re.compile(r"status (\d+)")
 # Message markers for error paths that carry no WC status: stalls and
 # connection loss are transient; everything unrecognized is fatal by
@@ -250,6 +271,16 @@ class TransportError(RuntimeError):
         self.status = status
         self.retryable = (_classify_retryable(text, status)
                           if retryable is None else bool(retryable))
+
+    @property
+    def kind(self) -> str:
+        """Coarse failure class: ``"integrity"`` for detected payload
+        corruption / stale-incarnation fences (retryable via the
+        elastic ladder), ``"transport"`` for everything else."""
+        if self.status == WC_INTEGRITY_ERR or \
+                "integrity" in str(self).lower():
+            return "integrity"
+        return "transport"
 
 
 def copy_pool_workers() -> int:
@@ -308,6 +339,62 @@ def note_fault_injections() -> int:
         _fault_hits_noted[0] = total
         trace.event("fault.injected", hits=new, total=total)
     return max(new, 0)
+
+
+# ------------------------------------------------------------------
+# Sealed-chunk integrity introspection: CRC32C for tests, the native
+# sealed/verified/failed/retransmitted counters, and their bridge into
+# the tracer's ``integrity.*`` namespace.
+
+def crc32c(data: bytes, seed: int = 0) -> int:
+    """CRC32C (Castagnoli) of ``data``; pass the previous return value
+    as ``seed`` to extend a running checksum."""
+    return int(_load().tdr_crc32c(data, len(data), seed))
+
+
+_SEAL_COUNTER_NAMES = ("sealed", "verified", "failed", "retransmitted")
+
+
+def seal_counters() -> dict:
+    """Process-wide integrity counters: frames sealed at send,
+    landings verified ok, verification failures, retransmissions."""
+    arr = (ctypes.c_uint64 * 4)()
+    _load().tdr_seal_counters(arr)
+    return dict(zip(_SEAL_COUNTER_NAMES, (int(v) for v in arr)))
+
+
+def seal_counters_reset() -> None:
+    _load().tdr_seal_counters_reset()
+    _integrity_noted.clear()
+    _integrity_noted.update({k: 0 for k in _SEAL_COUNTER_NAMES})
+
+
+def seal_retry_budget() -> int:
+    """The per-chunk retransmit budget AS THE ENGINE PARSES IT
+    (TDR_SEAL_RETRY, default 3) — the one source of truth the schedule
+    digest records."""
+    return int(_load().tdr_seal_retry_budget())
+
+
+_integrity_noted = {k: 0 for k in _SEAL_COUNTER_NAMES}
+
+
+def note_integrity() -> dict:
+    """Fold native seal-counter deltas since the last call into the
+    tracer as ``integrity.sealed`` / ``integrity.verified`` /
+    ``integrity.failed`` / ``integrity.retransmitted`` — the recovery
+    path and tests observe the whole detect→retransmit ladder in the
+    same stream as ``world.rebuild``/``trainer.resume``. Returns the
+    deltas."""
+    now = seal_counters()
+    deltas = {}
+    for k, v in now.items():
+        d = v - _integrity_noted.get(k, 0)
+        if d > 0:
+            trace.add(f"integrity.{k}", d)
+        deltas[k] = max(d, 0)
+        _integrity_noted[k] = v
+    return deltas
 
 
 def _check(cond, what: str):
@@ -456,6 +543,13 @@ class QueuePair:
         """Both ends negotiated the world-2 fused exchange schedule."""
         return bool(_load().tdr_qp_has_fused2(
             _live(self._h, "has_fused2")))
+
+    @property
+    def has_seal(self) -> bool:
+        """Both ends negotiated sealed payload framing (CRC32C +
+        incarnation tag, NAK-driven chunk retransmit). Emu-only; the
+        verbs wire carries its own ICRC."""
+        return bool(_load().tdr_qp_has_seal(_live(self._h, "has_seal")))
 
     def poll(self, max_wc: int = 16, timeout_ms: int = -1) -> List[Completion]:
         arr = (Wc * max_wc)()
@@ -689,6 +783,25 @@ class Engine:
         _check(h, "reg_dmabuf_mr")
         trace.event("mr.reg_dmabuf", bytes=length)
         return MemoryRegion(self, h)
+
+    def set_seal_context(self, generation: int, step: int = 0) -> None:
+        """Stamp this engine's seal context: outbound seals carry the
+        ring incarnation (+1 on the wire, 0 meaning unset) and the
+        training step; a landing sealed by a DIFFERENT live
+        incarnation is fenced as a stale-world ghost write. RingWorld
+        calls this after every bootstrap/rebuild generation
+        agreement."""
+        _load().tdr_seal_context(_live(self._h, "seal_context"),
+                                 int(generation) + 1, int(step))
+
+    def clear_seal_context(self) -> None:
+        """Unset the seal context (wire gen 0 = fence skipped).
+        RingWorld clears it at every bootstrap entry: generation
+        RECONCILIATION frames must not be fenced by a stamp retained
+        from a previous incarnation, or an asymmetrically-failed
+        rebuild (one rank stamped, its neighbor did not) would reject
+        the very frames that re-sync the ranks — on every retry."""
+        _load().tdr_seal_context(_live(self._h, "seal_context"), 0, 0)
 
     def listen(self, host: str = "127.0.0.1", port: int = 0,
                timeout_ms: int = -1) -> QueuePair:

@@ -23,11 +23,35 @@ if os.environ.get("TDR_DEBUG"):
     logging.basicConfig(level=logging.DEBUG)
     _LOG.setLevel(logging.DEBUG)
 
-_RING_CAP = 4096
+def _ring_cap() -> int:
+    """Event-ring bound (TDR_TRACE_RING overrides, min 64): long soak
+    runs must not grow memory without limit — counters keep the full
+    tally, the ring keeps only the last N events."""
+    env = os.environ.get("TDR_TRACE_RING", "")
+    if env:
+        try:
+            v = int(env)
+            if v > 0:
+                return max(v, 64)  # clamp UP to the documented minimum
+        except ValueError:
+            pass
+    return 4096
+
+
+_RING_CAP = _ring_cap()
 
 
 class _Tracer:
-    """Process-wide event tracer: counters + bounded event ring."""
+    """Process-wide event tracer: counters + bounded event ring.
+
+    Thread-safe by contract, not by accident: events and counters are
+    bumped from transport poller/progress threads, the staged-pipeline
+    worker, and per-rank test threads concurrently — every access to
+    the counter dict and the ring goes through ``_lock``. The ring is
+    a fixed-capacity deque (last ``_RING_CAP`` events), so unbounded
+    soak runs keep bounded memory; ``integrity.*`` and other
+    high-frequency counters use ``add`` (no ring entry) rather than
+    per-increment events."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -43,6 +67,15 @@ class _Tracer:
             self._ring.append((now, name, fields))
         if _LOG.isEnabledFor(logging.DEBUG):
             _LOG.debug("%s %s", name, fields)
+
+    def add(self, name: str, n: int = 1) -> None:
+        """Bump a counter by ``n`` without recording a ring event —
+        for bulk/delta accounting (the ``integrity.*`` counters fold
+        native seal-counter deltas in through here)."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._counters[name] += n
 
     def counter(self, name: str) -> int:
         with self._lock:

@@ -29,21 +29,34 @@ fs = _load_fault_soak()
 def test_make_fault_plan_is_seeded():
     assert fs.make_fault_plan(7, 4) == fs.make_fault_plan(7, 4)
     assert fs.make_fault_plan(7, 4).startswith("ring:nth=")
+    # The seeded plans now always carry a sealed-path corruption rider.
+    assert ",send:nth=" in fs.make_fault_plan(7, 4)
+    assert ":corrupt=" in fs.make_fault_plan(7, 4)
 
 
-def test_soak_short_seeded_parity(tmp_path):
-    """Clean vs injected-fault elastic training: identical final
-    params, and the fault demonstrably fired + was recovered from."""
+def test_soak_short_seeded_parity_mixed_plan(tmp_path):
+    """Clean vs injected-fault elastic training under a MIXED plan —
+    transient collective fault + sealed-payload corruption + a
+    connection drop: identical final params, every clause demonstrably
+    fired, and the corruption was detected (not silently averaged)."""
     steps, seed = 3, 1
-    plan = fs.make_fault_plan(seed, steps)
+    # make_fault_plan already mixes a ring fault with a corrupt rider;
+    # add a deterministic connection drop (the 13th SEND-class post
+    # lands mid-training for this config) for the full mixture.
+    plan = fs.make_fault_plan(seed, steps) + ",conn:drop_after=12"
     clean, _ = fs.run_soak(steps=steps, seed=seed,
                            ckpt_dir=str(tmp_path / "clean"))
     faulty, stats = fs.run_soak(steps=steps, seed=seed,
                                 ckpt_dir=str(tmp_path / "faulty"),
                                 fault_plan=plan)
-    assert stats["fault_hits"] == 1, stats
+    # ring fault + corruption are nth-bounded within the run, so both
+    # fire; the conn drop may add a third hit.
+    assert stats["fault_hits"] >= 2, stats
     assert stats["resumes"] >= 1, stats
     assert stats["rebuilds"] >= 2, stats  # begin/ok traced per rank
+    # The injected corruption was CAUGHT by the seal (and healed by
+    # retransmit or by the elastic resume — either way, detected).
+    assert stats["integrity_failed"] >= 1, stats
     la, lb = (jax.tree_util.tree_leaves(clean),
               jax.tree_util.tree_leaves(faulty))
     assert len(la) == len(lb)

@@ -188,6 +188,59 @@ def test_registration_manager_revocation_invalidates_mr(exporter):
     a.close(); b.close(); e.close()
 
 
+def test_free_racing_inflight_post_errors_fatally(exporter):
+    """Exporter free (→ free_callback → MR invalidate) racing an
+    in-flight post against the registered region: the WR completes
+    with SUCCESS or REM_ACCESS_ERR — never a crash or a write through
+    reclaimed pages — and the access error is non-retryable (the
+    elastic layer must re-raise lifetime bugs, not rebuild around
+    them)."""
+    e = eng.Engine("emu")
+    a, b = eng.loopback_pair(e, free_port())
+    mgr = RegistrationManager(e, exporter)
+    n = 4 << 20
+    va = exporter.alloc(n)
+    reg = mgr.register(va, n)
+    src = np.ones(n, dtype=np.uint8)
+    with e.reg_mr(src) as smr:
+        a.post_write(smr, 0, reg.mr.addr, reg.mr.rkey, n, wr_id=1)
+        exporter.free(va)  # owner frees while the write is in flight
+        wc = a.wait(1, timeout_ms=30000)
+        assert wc.status in (eng.WC_SUCCESS, eng.WC_REM_ACCESS_ERR)
+        # After the revocation settles, access fails deterministically
+        # and fatally.
+        a.post_write(smr, 0, reg.mr.addr, reg.mr.rkey, n, wr_id=2)
+        wc = a.wait(2, timeout_ms=30000)
+        assert wc.status == eng.WC_REM_ACCESS_ERR
+        assert not eng.TransportError(
+            f"completion error status {wc.status} (rem_access_err)"
+        ).retryable
+    mgr.deregister(reg)  # safe after revocation, any order
+    mgr.close()
+    a.close(); b.close(); e.close()
+
+
+def test_mark_gap_dead_does_not_disturb_inflight_post(exporter):
+    """mark_gap_dead is coalescing METADATA: marking a neighboring gap
+    dead while a post is outstanding must not perturb the transfer or
+    the pin — only is_gap_dead's answer."""
+    e = eng.Engine("emu")
+    a, b = eng.loopback_pair(e, free_port())
+    mgr = RegistrationManager(e, exporter)
+    va = exporter.alloc(8192)
+    reg = mgr.register(va, 4096)
+    src = np.full(4096, 9, dtype=np.uint8)
+    with e.reg_mr(src) as smr:
+        a.post_write(smr, 0, reg.mr.addr, reg.mr.rkey, 4096, wr_id=1)
+        exporter.mark_gap_dead(va + 4096, va + 8192)
+        assert a.wait(1, timeout_ms=30000).ok
+    assert exporter.is_gap_dead(va + 4096, va + 8192)
+    assert exporter.live_pins() == 1  # the pin is untouched
+    mgr.deregister(reg)
+    mgr.close()
+    a.close(); b.close(); e.close()
+
+
 def test_cleanup_on_close_reclaims_leaks(exporter):
     """Leaked registrations are reclaimed on close — the per-fd cleanup
     path for crashed tests (tests/amdp2ptest.c:115-139)."""

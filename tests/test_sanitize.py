@@ -1,0 +1,94 @@
+"""Sanitizer build gate (native/Makefile `make sanitize`).
+
+Tier-1 carries only a cheap smoke that the target stamps the right
+flags (.buildflags_san — no compilation); the slow tier rebuilds
+libtdr_san.so under ASan+UBSan and runs a world-2 SEALED ring
+allreduce under it, so the whole seal/NAK/retransmit machinery gets a
+memory-error and UB sweep on every slow run.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "rocnrdma_tpu", "native")
+
+
+def test_sanitize_target_stamps_flags():
+    """Cheap tier-1 smoke: the sanitize flag stamp carries the ASan +
+    UBSan + frame-pointer flags the slow-tier build compiles with."""
+    subprocess.run(["make", "-s", "-C", NATIVE, ".buildflags_san"],
+                   check=True, capture_output=True)
+    with open(os.path.join(NATIVE, ".buildflags_san")) as f:
+        stamp = f.read()
+    assert "-fsanitize=address,undefined" in stamp
+    assert "-fno-omit-frame-pointer" in stamp
+
+
+def _libasan_path():
+    gcc = shutil.which("gcc")
+    if not gcc:
+        return None
+    out = subprocess.run([gcc, "-print-file-name=libasan.so"],
+                         capture_output=True, text=True)
+    path = out.stdout.strip()
+    return path if path and os.path.isabs(path) and os.path.exists(path) \
+        else None
+
+
+_SAN_SCRIPT = """
+import socket, threading
+import numpy as np
+from rocnrdma_tpu.collectives.world import local_worlds
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+worlds = local_worlds(2, port)
+assert worlds[0].left_qp.has_seal, "seal must be on under the sanitizer"
+bufs = [np.full(65536, float(r + 1), dtype=np.float32) for r in range(2)]
+ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
+      for r in range(2)]
+[t.start() for t in ts]; [t.join() for t in ts]
+for b in bufs:
+    np.testing.assert_array_equal(b, np.full(65536, 3.0, np.float32))
+for w in worlds:
+    w.close()
+print("SAN_WORLD2_OK")
+"""
+
+
+@pytest.mark.slow
+def test_sanitized_sealed_world2_allreduce():
+    """Rebuild libtdr.so under ASan+UBSan and drive a world-2 sealed
+    ring allreduce through it in a subprocess (ASan must be the first
+    DSO, hence LD_PRELOAD). Any heap error aborts; any UBSan report
+    fails the assertion on output."""
+    libasan = _libasan_path()
+    if libasan is None:
+        pytest.skip("no gcc/libasan on this host")
+    build = subprocess.run(["make", "-s", "-C", NATIVE, "sanitize"],
+                           capture_output=True, text=True, timeout=600)
+    assert build.returncode == 0, build.stderr[-2000:]
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": libasan,
+        # abort_on_error surfaces ASan findings as a non-zero exit
+        # even where the default exit path is swallowed; leak checking
+        # is off (the CPython interpreter's arenas drown the signal).
+        "ASAN_OPTIONS": "detect_leaks=0,abort_on_error=1",
+        "UBSAN_OPTIONS": "print_stacktrace=1",
+        "TDR_NATIVE_LIB": os.path.join(NATIVE, "libtdr_san.so"),
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+    })
+    run = subprocess.run([sys.executable, "-c", _SAN_SCRIPT],
+                         capture_output=True, text=True, timeout=300,
+                         env=env)
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, out[-3000:]
+    assert "SAN_WORLD2_OK" in out, out[-3000:]
+    assert "runtime error" not in out, out[-3000:]   # UBSan reports
+    assert "AddressSanitizer" not in out, out[-3000:]

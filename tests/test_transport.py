@@ -289,6 +289,60 @@ def test_use_after_close_raises_cleanly():
         e.reg_mr(buf)
 
 
+def test_invalidate_racing_inflight_target(loop):
+    """tdr_mr_invalidate while a post against the TARGET is in flight:
+    the WR must complete — with SUCCESS (it won the race) or an access
+    error (it lost) — never corrupt reclaimed memory or crash, and the
+    access error must classify as FATAL (non-retryable taxonomy)."""
+    e, a, b = loop
+    n = 8 << 20
+    src = np.ones(n, dtype=np.uint8)
+    dst = np.zeros(n, dtype=np.uint8)
+    smr = e.reg_mr(src)
+    dmr = e.reg_mr(dst)
+    a.post_write(smr, 0, dmr.addr, dmr.rkey, n, wr_id=1)
+    # Revoke the landing target while the transfer may be mid-flight;
+    # invalidate() quiesces (blocks out the in-progress landing) so
+    # returning means no late write can touch the pages.
+    dmr.invalidate()
+    wc = a.wait(1, timeout_ms=30000)
+    assert wc.status in (eng.WC_SUCCESS, eng.WC_REM_ACCESS_ERR)
+    # Post-invalidate traffic deterministically errors, and the error
+    # is fatal: a lifetime bug, not a rebuildable transient.
+    a.post_write(smr, 0, dmr.addr, dmr.rkey, n, wr_id=2)
+    wc = a.wait(2, timeout_ms=30000)
+    assert wc.status == eng.WC_REM_ACCESS_ERR
+    err = eng.TransportError("completion error status "
+                             f"{wc.status} (rem_access_err)")
+    assert not err.retryable
+    dmr.deregister()
+    smr.deregister()
+
+
+def test_invalidate_racing_inflight_source(loop):
+    """tdr_mr_invalidate on the SOURCE of an outstanding send: the
+    pending op holds an inflight ref, so invalidate() blocks until the
+    exchange completes — the payload that arrives is intact, never a
+    torn read from reclaimed pages; later posts on the dead MR fail
+    immediately."""
+    e, a, b = loop
+    n = 4 << 20
+    msg = np.full(n, 3, dtype=np.uint8)
+    inbox = np.zeros(n, dtype=np.uint8)
+    smr = e.reg_mr(msg)
+    rmr = e.reg_mr(inbox)
+    b.post_recv(rmr, 0, n, wr_id=1)
+    a.post_send(smr, 0, n, wr_id=2)
+    smr.invalidate()  # blocks until the peer is done with the source
+    assert a.wait(2, timeout_ms=30000).ok
+    assert b.wait(1, timeout_ms=30000).ok
+    assert (inbox == 3).all()
+    with pytest.raises(eng.TransportError):
+        a.post_send(smr, 0, n, wr_id=3)
+    smr.deregister()
+    rmr.deregister()
+
+
 def test_dereg_waits_for_inflight_dma(loop):
     """dereg during a remote write must not free memory under the
     in-flight 'DMA' (ibv_dereg_mr semantics in the emu backend)."""

@@ -38,15 +38,27 @@ def free_port() -> int:
 
 
 def make_fault_plan(seed: int, steps: int, world: int = 2) -> str:
-    """A seeded-random transient collective fault somewhere in the run.
+    """A seeded-random transient collective fault somewhere in the run,
+    plus a seeded payload corruption on the sealed zero-copy path.
 
     ``ring:nth`` counts tdr_ring_allreduce calls process-wide (~world
     per training step with both ranks in-process), so the same seed
     always faults the same call ordinal; which rank's thread lands on
-    it may vary, but the parity predicate is rank-independent."""
+    it may vary, but the parity predicate is rank-independent.
+
+    The ``send:...:corrupt=`` rider flips bytes on one sealed frame's
+    WIRE copy somewhere in the run: the seal detects it at land time
+    and the chunk retransmits from the intact source — normally with
+    no trainer-visible error at all, which is exactly the containment
+    the parity predicate then proves (bitwise-equal to the clean run).
+    send arrivals are plentiful (every digest hop and gradient chunk),
+    so a small nth is guaranteed to fire."""
     rng = random.Random(seed)
     nth = rng.randrange(1, max(2, steps * world))
-    return f"ring:nth={nth}:once=general_err"
+    plan = f"ring:nth={nth}:once=general_err"
+    cnth = rng.randrange(1, max(2, steps * world))
+    plan += f",send:nth={cnth}:corrupt={rng.randrange(1, 5)}"
+    return plan
 
 
 def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
@@ -64,7 +76,8 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
     from rocnrdma_tpu.parallel.trainer import ElasticPolicy, Trainer
     from rocnrdma_tpu.transport.engine import (Engine, fault_plan_clauses,
                                                fault_plan_hits,
-                                               fault_plan_reset)
+                                               fault_plan_reset,
+                                               seal_counters)
     from rocnrdma_tpu.utils.trace import trace
 
     world = 2
@@ -85,6 +98,7 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
     fault_plan_reset()
     resumes0 = trace.counter("trainer.resume")
     rebuilds0 = trace.counter("world.rebuild")
+    seal0 = seal_counters()
 
     results = [None] * world
     errs = [None] * world
@@ -140,10 +154,15 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
     for a, b in zip(leaves0, leaves1):
         if np.asarray(a).tobytes() != np.asarray(b).tobytes():
             raise AssertionError("ranks diverged: DP lockstep broken")
+    seal1 = seal_counters()
     stats = {
         "fault_hits": int(hits),
         "resumes": trace.counter("trainer.resume") - resumes0,
         "rebuilds": trace.counter("world.rebuild") - rebuilds0,
+        # Integrity ladder activity during the run: detected
+        # corruptions and the retransmissions that healed them.
+        "integrity_failed": seal1["failed"] - seal0["failed"],
+        "retransmits": seal1["retransmitted"] - seal0["retransmitted"],
     }
     return results[0], stats
 
