@@ -221,6 +221,101 @@ def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
     return out
 
 
+def bench_telemetry(sizes):
+    """Flight-recorder sub-bench (runs AFTER the headline so recording
+    overhead cannot touch the headline numbers).
+
+    Two halves of the contract:
+    - the headline benches above ran with TDR_TELEMETRY unset, so
+      ``events_while_disabled`` must be 0 — the one-branch guard is
+      asserted, not assumed (skipped when the ambient env already has
+      recording on);
+    - a telemetry-on allreduce then populates the native log2
+      histograms, from which the record's latency percentiles and
+      bandwidth distribution are pulled.
+    """
+    from rocnrdma_tpu import telemetry
+    from rocnrdma_tpu.transport.engine import telemetry_recorded
+
+    out = {}
+    ambient_on = os.environ.get("TDR_TELEMETRY", "0") not in ("", "0")
+    if not ambient_on:
+        out["events_while_disabled"] = telemetry_recorded()
+        assert out["events_while_disabled"] == 0, \
+            "flight recorder recorded events with TDR_TELEMETRY off"
+    telemetry.enable()
+    try:
+        bench_allreduce(count=sizes["tel_count"], world=2, iters=2)
+        snap = telemetry.snapshot()
+        out["events_recorded"] = snap["recorded"]
+        out["events_dropped"] = snap["dropped"]
+        out["chunk_lat_us"] = snap["percentiles"]["chunk_lat_us"]
+        out["ring_lat_us"] = snap["percentiles"]["ring_lat_us"]
+        out["ring_MBps"] = snap["percentiles"]["ring_MBps"]
+        out["chunk_bytes"] = snap["percentiles"]["chunk_bytes"]
+        out["counters"] = {
+            k: v for k, v in snap["counters"].items()
+            if k.split(".")[0] in ("integrity", "fault", "copy",
+                                   "telemetry") and v
+        }
+    finally:
+        if ambient_on:
+            telemetry.reset()
+        else:
+            telemetry.disable()
+    return out
+
+
+def write_bench_record(details, bus, tel, quick, details_path):
+    """The machine-readable bench record (BENCH_<round>.json): the
+    bw/latency/staging triple CI diffs future runs against. Quick-mode
+    runs write next to the (redirected) details file so toy numbers
+    never clobber the repo's official trajectory point."""
+    from rocnrdma_tpu.collectives.staging import staging
+
+    rnd = os.environ.get("TDR_BENCH_ROUND", "r06")
+    record = {
+        "round": rnd,
+        "quick_mode": quick,
+        "schema": 1,
+        "bw_GBps": {
+            "allreduce_world2_bus": round(bus, 3),
+            "p2p_write": details.get("p2p_write_GBps"),
+            "alltoall_world2_link": details.get("alltoall_world2_link_GBps"),
+            "allreduce_world4_bus": details.get("allreduce_world4_bus_GBps"),
+            "staged_pipelined": details.get("staged_pipelined_GBps"),
+            "staged_serial": details.get("staged_serial_GBps"),
+        },
+        # Log2-histogram upper-edge percentiles from the native flight
+        # recorder (chunk = post→completion of individual transport
+        # ops; ring = whole collectives).
+        "lat": {
+            "chunk_us": tel.get("chunk_lat_us"),
+            "ring_us": tel.get("ring_lat_us"),
+        },
+        "ring_MBps": tel.get("ring_MBps"),
+        "staged_bytes": {
+            "collectives.staging": staging.bytes,
+            "copy.nt_bytes": details.get("p2p_copy_tier", {}).get("nt_bytes"),
+            "copy.plain_bytes": details.get("p2p_copy_tier",
+                                            {}).get("plain_bytes"),
+        },
+        "telemetry": {k: v for k, v in tel.items()
+                      if k in ("events_while_disabled", "events_recorded",
+                               "events_dropped")},
+    }
+    path = os.environ.get("TDR_BENCH_RECORD")
+    if not path:
+        path = (os.path.join(os.path.dirname(details_path),
+                             "BENCH_record_quick.json") if quick
+                else os.path.join(REPO, f"BENCH_{rnd}.json"))
+    elif not os.path.isabs(path):
+        path = os.path.join(REPO, path)
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+    return path
+
+
 def bench_sweep(timeout_s=300, max_size="1G"):
     """Config-2: the 4 B–1 GiB message-size sweep (peak bandwidth with
     the tool's pipelined tx-depth) plus small-message latency from a
@@ -479,6 +574,7 @@ def main():
         "a2a_count": ((2 << 20) // 4) if quick else ((32 << 20) // 4),
         "staged_nbytes": (4 << 20) if quick else (512 << 20),
         "sweep_max": "64K" if quick else "1G",
+        "tel_count": ((1 << 20) // 4) if quick else ((64 << 20) // 4),
     }
     details["quick_mode"] = quick
     details["copy_pool_workers"] = copy_pool_workers()
@@ -547,6 +643,12 @@ def main():
         }
     details.update(bench_staged(nbytes=sizes["staged_nbytes"]))
     details["sweep_write"] = bench_sweep(max_size=sizes["sweep_max"])
+    # Flight-recorder sub-bench LAST among the transport benches: it
+    # both asserts the disabled-mode zero-event contract for the whole
+    # run above and pulls histogram latency percentiles for the
+    # machine-readable record.
+    tel = bench_telemetry(sizes)
+    details["telemetry"] = tel
     if os.environ.get("TDR_BENCH_NO_TPU", "0") in ("", "0"):
         details.update(bench_tpu_details())
     else:
@@ -559,9 +661,11 @@ def main():
     # Everything bulky (the message sweep, banked TPU blobs, copy-tier
     # counters) goes to BENCH_DETAILS.json, referenced by name.
     details_file = os.environ.get("TDR_BENCH_DETAILS", "BENCH_DETAILS.json")
-    with open(os.path.join(REPO, details_file) if not os.path.isabs(
-            details_file) else details_file, "w") as f:
+    details_path = (os.path.join(REPO, details_file)
+                    if not os.path.isabs(details_file) else details_file)
+    with open(details_path, "w") as f:
         json.dump(details, f, indent=1)
+    record_path = write_bench_record(details, bus, tel, quick, details_path)
     tpu = details.get("tpu", "not probed")
     if not isinstance(tpu, str):
         tpu = "reachable"
@@ -584,6 +688,7 @@ def main():
         "staged_serial_GBps": details.get("staged_serial_GBps"),
         "tpu": tpu[:160],
         "details_file": details_file,
+        "bench_record": os.path.basename(record_path),
     }))
 
 

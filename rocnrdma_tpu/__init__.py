@@ -15,6 +15,10 @@ Re-imagines the capabilities of AMD's ``amdp2p`` PeerDirect bridge
   and a hardware-free emulated backend for CI.
 - ``collectives``: cross-slice (DCN) ring allreduce over the transport,
   replacing XLA's host-staged DCN copy, plus staging-byte accounting.
+- ``telemetry``: the flight recorder — engine-side chunk-lifecycle
+  event ring (native ``telemetry.cc``), log2 latency/bandwidth
+  histograms, the unified counter registry, and Chrome/Perfetto
+  export merging native and Python-tier timelines on one clock.
 - ``parallel`` / ``models`` / ``ops``: the JAX consumer stack — device
   meshes, a Llama model family, Pallas TPU kernels, and a DP trainer
   whose cross-slice gradient allreduce rides the zero-copy path.

@@ -301,6 +301,13 @@ class CrossSliceAllReduce:
     # ------------------------------------------------------- main path
 
     def __call__(self, tree):
+        # The whole cross-slice sync runs under one span: in the
+        # merged flight-recorder timeline it is the bar over every
+        # world.allreduce span and native chunk event the sync causes.
+        with trace.span("xslice.sync", rank=self.world.rank):
+            return self._sync(tree)
+
+    def _sync(self, tree):
         import jax
 
         leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -478,6 +485,8 @@ class CrossSliceAllReduce:
         total = int(sum(sizes))
         buf = self._stage(dtype_str, total)
         staging.add(total * itemsize * 2)  # D2H + H2D round trip
+        trace.event("xslice.staged_group", dtype=dtype_str,
+                    bytes=total * itemsize, leaves=len(idxs))
 
         # Kick asynchronous D2H for every device leaf up front so the
         # per-segment gathers find bytes already on their way.

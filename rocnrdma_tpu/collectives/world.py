@@ -176,8 +176,11 @@ class RingWorld:
         except BaseException:
             self._teardown()
             raise
+        # tel_engine ties this rank to its native flight-recorder
+        # track, so exporters label the engine timeline "rank N".
         trace.event("world.up", rank=rank, world=world,
-                    generation=self.generation)
+                    generation=self.generation,
+                    tel_engine=self.engine.telemetry_id)
 
     def _ensure_digest_bufs(self) -> None:
         if self._dg_smr is not None:
@@ -201,40 +204,58 @@ class RingWorld:
         self.generation = gen
 
     # ---------------------------------------------------- collectives
+    #
+    # Every collective runs under a trace.span carrying rank and byte
+    # count: in the merged flight-recorder timeline the span is the
+    # bar over the native chunk instants (post/tx/land/retx/wc) it
+    # contains, so a training step reads top-down from ring_allreduce
+    # to an individual chunk retransmit.
 
     def allreduce(self, array, op: int = RED_SUM) -> None:
         """In-place ring allreduce of a C-contiguous numpy array."""
-        self.ring.allreduce(array, op)
+        with trace.span("world.allreduce", rank=self.rank,
+                        bytes=int(array.nbytes)):
+            self.ring.allreduce(array, op)
 
     def reduce_scatter(self, array, op: int = RED_SUM) -> slice:
         """In-place reduce-scatter; returns the element slice this
         rank owns afterwards (allreduce ≡ reduce_scatter then
         all_gather on the same buffer)."""
-        return self.ring.reduce_scatter(array, op)
+        with trace.span("world.reduce_scatter", rank=self.rank,
+                        bytes=int(array.nbytes)):
+            return self.ring.reduce_scatter(array, op)
 
     def all_gather(self, array) -> None:
         """In-place all-gather of per-rank owned segments (the layout
         ``reduce_scatter`` leaves)."""
-        self.ring.all_gather(array)
+        with trace.span("world.all_gather", rank=self.rank,
+                        bytes=int(array.nbytes)):
+            self.ring.all_gather(array)
 
     def broadcast(self, array, root: int = 0) -> None:
         """Broadcast root's buffer to every rank (store-and-forward
         chunk pipeline down the ring)."""
-        self.ring.broadcast(array, root)
+        with trace.span("world.broadcast", rank=self.rank,
+                        bytes=int(array.nbytes)):
+            self.ring.broadcast(array, root)
 
     def all_to_all(self, array) -> None:
         """In-place all-to-all: the flat buffer is ``world`` equal
         segments, segment j FOR rank j on entry, FROM rank j on
         return (MPI_Alltoall; sequence<->head resharding's primitive,
         collectives/ulysses.py)."""
-        self.ring.all_to_all(array)
+        with trace.span("world.all_to_all", rank=self.rank,
+                        bytes=int(array.nbytes)):
+            self.ring.all_to_all(array)
 
     def reduce(self, array, root: int = 0, op: int = RED_SUM) -> None:
         """Root-reduce: root's buffer ends holding the reduction over
         all ranks; non-root buffers are clobbered with the partials
         that passed through them (use allreduce when every rank needs
         the result intact)."""
-        self.ring.reduce(array, root, op)
+        with trace.span("world.reduce", rank=self.rank,
+                        bytes=int(array.nbytes)):
+            self.ring.reduce(array, root, op)
 
     def set_seal_step(self, step: int) -> None:
         """Stamp the training step into outbound seals (informational

@@ -161,6 +161,108 @@ void tdr_seal_context(tdr_engine *e, uint64_t gen_plus1, uint64_t step);
  * verbs backend relies on the wire's ICRC and advertises 0). */
 int tdr_qp_has_seal(tdr_qp *qp);
 
+/* ------------------------------------------------------------------ *
+ * Flight recorder — the engine-side telemetry subsystem.
+ *
+ * When TDR_TELEMETRY is set (and not "0"), every stage of the chunk
+ * lifecycle on both backends (post → wire tx/rx → land → seal
+ * verify/NAK/retransmit → fold → completion, plus copy-pool
+ * enqueue/run and ring-collective begin/end) records a fixed-size
+ * timestamped event into a bounded process-wide ring, and log2-bucket
+ * latency/bandwidth histograms accumulate alongside. When the knob is
+ * off, every event site costs exactly one predicted branch — no
+ * clock reads, no stores — and tdr_tel_recorded()/tdr_tel_dropped()
+ * stay 0 (the bench smoke asserts this).
+ *
+ * Clock domain: CLOCK_MONOTONIC nanoseconds — the same clock Python's
+ * time.monotonic() reads on Linux, so native events and the Python
+ * tracer's ring merge into one timeline without translation
+ * (tdr_tel_now_ns anchors the correspondence).
+ *
+ * Ring capacity: TDR_TELEMETRY_RING events (default 65536, clamped to
+ * [1024, 4Mi]). When full, the OLDEST event is overwritten (flight-
+ * recorder semantics: the recent past survives a long soak) and the
+ * dropped counter counts the overwrite.
+ * ------------------------------------------------------------------ */
+
+/* Event types (tdr_tel_event.type). */
+enum {
+  TDR_TEL_NONE = 0,
+  TDR_TEL_POST_SEND = 1,   /* id=wr_id, arg=bytes                     */
+  TDR_TEL_POST_RECV = 2,   /* id=wr_id, arg=maxlen                    */
+  TDR_TEL_POST_WRITE = 3,  /* id=wr_id, arg=bytes                     */
+  TDR_TEL_POST_READ = 4,   /* id=wr_id, arg=bytes                     */
+  TDR_TEL_WIRE_TX = 5,     /* frame leaves the wire/desc path:
+                              id=frame seq, arg=bytes                 */
+  TDR_TEL_WIRE_RX = 6,     /* frame header arrived: id=seq, arg=bytes */
+  TDR_TEL_LAND = 7,        /* payload materialized at its target      */
+  TDR_TEL_VERIFY_OK = 8,   /* seal verification passed: id=seq        */
+  TDR_TEL_VERIFY_FAIL = 9, /* seal verification failed: id=seq        */
+  TDR_TEL_NAK = 10,        /* receiver NAKs chunk seq (arg=attempt)   */
+  TDR_TEL_RETX = 11,       /* sender re-posts chunk seq (arg=bytes)   */
+  TDR_TEL_FOLD = 12,       /* payload folded into an accumulator      */
+  TDR_TEL_WC = 13,         /* completion delivered: id=wr_id,
+                              arg=TDR_WC_* status                     */
+  TDR_TEL_COPY_ENQ = 14,   /* copy-pool job submitted: arg=work units */
+  TDR_TEL_COPY_RUN = 15,   /* copy-pool job finished: arg=duration us */
+  TDR_TEL_RING_BEGIN = 16, /* collective entry: id=call seq, arg=bytes*/
+  TDR_TEL_RING_END = 17,   /* collective exit: arg=0 ok / 1 failed    */
+};
+
+/* Histograms (tdr_tel_hist_read). Log2 buckets: bucket b (1..63)
+ * counts values in [2^(b-1), 2^b); bucket 0 counts zeros. */
+enum {
+  TDR_HIST_CHUNK_LAT_US = 0, /* post → completion latency, us    */
+  TDR_HIST_CHUNK_BYTES = 1,  /* completed op payload sizes       */
+  TDR_HIST_COPY_BYTES = 2,   /* copy-pool memcpy sizes           */
+  TDR_HIST_RING_LAT_US = 3,  /* whole-collective latency, us     */
+  TDR_HIST_RING_MBPS = 4,    /* whole-collective bandwidth, MB/s */
+  TDR_HIST_COUNT = 5,
+};
+
+typedef struct {
+  uint64_t ts_ns;  /* CLOCK_MONOTONIC */
+  uint16_t type;   /* TDR_TEL_* */
+  uint16_t engine; /* engine track id (tdr_tel_engine_id) */
+  uint32_t qp;     /* qp track id (tdr_tel_qp_id), 0 = none */
+  uint64_t id;     /* wr_id / frame seq / call seq */
+  uint64_t arg;    /* bytes / status / attempt (per type) */
+} tdr_tel_event;
+
+int tdr_tel_enabled(void);
+/* Re-read TDR_TELEMETRY / TDR_TELEMETRY_RING and clear the ring,
+ * histograms, and recorded/dropped counts (tests toggle the env then
+ * call this, like tdr_fault_plan_reset). */
+void tdr_tel_reset(void);
+uint64_t tdr_tel_now_ns(void);
+/* Remove up to `max` events from the ring, oldest first. */
+int tdr_tel_drain(tdr_tel_event *out, int max);
+uint64_t tdr_tel_recorded(void); /* events recorded since reset */
+uint64_t tdr_tel_dropped(void);  /* events overwritten unread   */
+const char *tdr_tel_event_name(int type);
+int tdr_tel_hist_count(void);
+const char *tdr_tel_hist_name(int which);
+void tdr_tel_hist_read(int which, uint64_t out[64]);
+/* Stable per-process track ids (assigned at open/bring-up whether or
+ * not telemetry is enabled — they also label exported timelines). */
+int tdr_tel_engine_id(const tdr_engine *e);
+int tdr_tel_qp_id(const tdr_qp *qp);
+
+/* Unified native counter registry: one call reads every engine-side
+ * counter — the seal/integrity ladder, fault-plan aggregates, copy
+ * tiers, and the telemetry ring's own accounting — under stable
+ * dotted names, replacing per-subsystem polling (whose multi-call
+ * windows could double-count deltas). Counters that share a producer
+ * (fault seen/hits; the copy tiers) are gathered in one pass so a
+ * snapshot never shows impossible relations (hits > seen); counters
+ * from DIFFERENT subsystems are individually-atomic monotonic reads,
+ * not a global freeze. */
+int tdr_counter_count(void);
+const char *tdr_counter_name(int idx);
+/* Fill out[0..min(max, count)) in registry order; returns the number
+ * written. */
+int tdr_counters_read(uint64_t *out, int max);
+
 /* spec: "emu", "verbs", "verbs:<device>", or "auto" (verbs, else emu). */
 tdr_engine *tdr_engine_open(const char *spec);
 void tdr_engine_close(tdr_engine *e);

@@ -17,6 +17,37 @@ namespace tdr {
 void set_error(const std::string &msg);
 const char *get_error();
 
+// ------------------------------------------------------------------
+// Flight recorder (telemetry.cc): the engine-side event ring +
+// log2-bucket histograms behind TDR_TELEMETRY (see tdr.h for the
+// public surface and event taxonomy). The contract every call site
+// honors: when telemetry is off, the site costs ONE predicted branch
+// (an atomic relaxed load) — no clock read, no lock, no store — so
+// the zero-copy hot path is unchanged. Track ids are assigned
+// unconditionally (they are just counters) so exported timelines stay
+// stable whether recording was on from the start or enabled later.
+// ------------------------------------------------------------------
+// 0 = not yet parsed, 1 = off, 2 = on.
+extern std::atomic<int> g_tel_state;
+int tel_state_init();  // parses TDR_TELEMETRY once; returns 1 or 2
+inline bool tel_on() {
+  int s = g_tel_state.load(std::memory_order_relaxed);
+  if (__builtin_expect(s == 0, 0)) s = tel_state_init();
+  return s == 2;
+}
+uint64_t tel_now_ns();
+void tel_emit(uint16_t type, uint16_t engine, uint32_t qp, uint64_t id,
+              uint64_t arg);
+void tel_hist_add(int which, uint64_t value);
+uint16_t tel_next_engine_id();
+uint32_t tel_next_qp_id();
+
+// One-branch event site: evaluates its arguments only when recording.
+#define TDR_TEL(type, eng, qp, id, arg)                                  \
+  do {                                                                   \
+    if (tdr::tel_on()) tdr::tel_emit((type), (eng), (qp), (id), (arg));  \
+  } while (0)
+
 class Engine;
 
 class Mr {
@@ -43,6 +74,9 @@ class Mr {
 class Qp {
  public:
   virtual ~Qp() = default;
+  // Telemetry track id — a process-wide bring-up ordinal, assigned
+  // whether or not recording is on (it names the exported timeline).
+  const uint32_t tel_id = tel_next_qp_id();
   virtual int post_write(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                          size_t len, uint64_t wr_id) = 0;
   virtual int post_read(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
@@ -91,6 +125,8 @@ class Qp {
 class Engine {
  public:
   virtual ~Engine() = default;
+  // Telemetry track id (open ordinal; see Qp::tel_id).
+  const uint16_t tel_id = tel_next_engine_id();
   virtual int kind() const = 0;
   virtual const char *name() const = 0;
   virtual Mr *reg_mr(void *addr, size_t len, int access) = 0;
@@ -168,6 +204,12 @@ uint64_t fault_clause_hits(size_t idx);
 uint64_t fault_clause_seen(size_t idx);
 // Re-parse TDR_FAULT_PLAN from the environment, zeroing all counters.
 void fault_plan_reset();
+// Whole-plan aggregates (sum over clauses) for the native counter
+// registry: seen and hits are gathered in ONE locked pass, so a
+// registry snapshot can never show hits > seen.
+void fault_totals(uint64_t *seen, uint64_t *hits);
+uint64_t fault_total_hits();
+uint64_t fault_total_seen();
 
 // CRC32C (Castagnoli), hardware-accelerated when the build has
 // SSE4.2, table-driven otherwise. Incremental: seed with the previous

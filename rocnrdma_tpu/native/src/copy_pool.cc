@@ -118,6 +118,9 @@ constexpr size_t kNtThreshold = 512u << 10;
 std::atomic<uint64_t> g_nt_bytes{0};
 std::atomic<uint64_t> g_plain_bytes{0};
 
+// Flight recorder: copy-pool job ordinal (pairs COPY_ENQ/COPY_RUN).
+std::atomic<uint64_t> g_copy_seq{0};
+
 inline void fast_copy(void *dst, const void *src, size_t len) {
   if (len >= kNtThreshold) {
     g_nt_bytes.fetch_add(len, std::memory_order_relaxed);
@@ -189,26 +192,38 @@ class CopyPool {
   void parfor(size_t n, size_t grain,
               const std::function<void(size_t, size_t)> &fn) {
     if (n == 0) return;
+    // Flight recorder: enqueue/run bracket for the pool job (the
+    // emulated DMA engine's dispatch trace). Serial fallbacks record
+    // too — a 1-core host still "runs the DMA engine", inline.
+    uint64_t tel_seq = 0, tel_t0 = 0;
+    if (tel_on()) {
+      tel_seq = g_copy_seq.fetch_add(1, std::memory_order_relaxed) + 1;
+      tel_t0 = tel_now_ns();
+      tel_emit(TDR_TEL_COPY_ENQ, 0, 0, tel_seq, n);
+    }
     if (nthreads_ <= 1 || n <= grain) {
       fn(0, n);
-      return;
+    } else {
+      std::lock_guard<std::mutex> region(region_mu_);
+      Job job;
+      job.fn = &fn;
+      job.n = n;
+      job.grain = grain;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        job_ = &job;
+      }
+      cv_.notify_all();
+      run_slices(job);
+      std::unique_lock<std::mutex> lk(mu_);
+      done_cv_.wait(lk, [&] {
+        return job.active.load(std::memory_order_acquire) == 0;
+      });
+      job_ = nullptr;  // still under mu_: no worker can deref after this
     }
-    std::lock_guard<std::mutex> region(region_mu_);
-    Job job;
-    job.fn = &fn;
-    job.n = n;
-    job.grain = grain;
-    {
-      std::lock_guard<std::mutex> g(mu_);
-      job_ = &job;
-    }
-    cv_.notify_all();
-    run_slices(job);
-    std::unique_lock<std::mutex> lk(mu_);
-    done_cv_.wait(lk, [&] {
-      return job.active.load(std::memory_order_acquire) == 0;
-    });
-    job_ = nullptr;  // still under mu_: no worker can deref after this
+    if (tel_t0)
+      tel_emit(TDR_TEL_COPY_RUN, 0, 0, tel_seq,
+               (tel_now_ns() - tel_t0) / 1000);
   }
 
  private:
@@ -264,6 +279,7 @@ class CopyPool {
 size_t copy_pool_workers() { return CopyPool::instance().workers(); }
 
 void par_memcpy(void *dst, const void *src, size_t len) {
+  if (tel_on()) tel_hist_add(TDR_HIST_COPY_BYTES, len);
   CopyPool::instance().parfor(len, kGrain, [&](size_t b, size_t e) {
     fast_copy(static_cast<char *>(dst) + b,
               static_cast<const char *>(src) + b, e - b);

@@ -498,6 +498,9 @@ struct PendingOp {
   const char *src = nullptr;
   uint64_t raddr = 0;
   uint32_t rkey = 0;
+  // Flight recorder: post timestamp feeding the post→completion
+  // latency histogram. 0 when telemetry is off (no clock read).
+  uint64_t post_ns = 0;
 };
 
 // RAII pair for EmuEngine::landing_begin: guarantees the inflight ref
@@ -526,6 +529,8 @@ struct PostedRecv {
   // posted order even when a NAK/retransmit cycle finishes a later
   // recv first (the ring layers assume FIFO recv completion).
   uint64_t ticket = 0;
+  // Flight recorder: post timestamp (0 = telemetry off at post time).
+  uint64_t post_ns = 0;
 };
 
 bool EmuMr::quiesce_wait() {
@@ -565,6 +570,27 @@ class EmuQp : public Qp {
     if (progress_.joinable()) progress_.join();
   }
 
+  // Flight-recorder event bound to this QP's (engine, qp) tracks —
+  // one predicted branch when TDR_TELEMETRY is off.
+  void tel(uint16_t type, uint64_t id, uint64_t arg) {
+    TDR_TEL(type, eng_->tel_id, tel_id, id, arg);
+  }
+
+  // Completion accounting: the WC event plus the post→completion
+  // latency and payload-size histograms. Successful ops only for
+  // both: errored lengths are not traffic, and a flushed WR's
+  // "latency" is the stall-until-teardown duration — recording it
+  // would let one fault run poison the p99 the bench record diffs.
+  void tel_wc(uint64_t wr_id, int status, uint64_t len, uint64_t post_ns) {
+    if (!tel_on()) return;
+    tel_emit(TDR_TEL_WC, eng_->tel_id, tel_id, wr_id,
+             static_cast<uint64_t>(status));
+    if (status != TDR_WC_SUCCESS) return;
+    if (post_ns)
+      tel_hist_add(TDR_HIST_CHUNK_LAT_US, (tel_now_ns() - post_ns) / 1000);
+    if (len) tel_hist_add(TDR_HIST_CHUNK_BYTES, len);
+  }
+
   // Fault-plan hook shared by every post path: a conn-drop clause
   // shuts this QP's socket down (the post then flushes, and the peer
   // sees RC connection loss); a send-site clause completes the WR
@@ -586,6 +612,7 @@ class EmuQp : public Qp {
 
   int post_write(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                  size_t len, uint64_t wr_id) override {
+    tel(TDR_TEL_POST_WRITE, wr_id, len);
     fault_post(nullptr, TDR_OP_WRITE, wr_id);
     char *src = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
@@ -615,6 +642,7 @@ class EmuQp : public Qp {
 
   int post_read(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                 size_t len, uint64_t wr_id) override {
+    tel(TDR_TEL_POST_READ, wr_id, len);
     fault_post(nullptr, TDR_OP_READ, wr_id);
     char *dst = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
@@ -641,6 +669,7 @@ class EmuQp : public Qp {
   }
 
   int post_send(Mr *lmr, size_t loff, size_t len, uint64_t wr_id) override {
+    tel(TDR_TEL_POST_SEND, wr_id, len);
     if (fault_post("send", TDR_OP_SEND, wr_id)) return 0;
     char *src = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
@@ -683,6 +712,7 @@ class EmuQp : public Qp {
       set_error("post_send_foldback: not negotiated with peer");
       return -1;
     }
+    tel(TDR_TEL_POST_SEND, wr_id, len);
     if (fault_post("send", TDR_OP_SEND, wr_id)) return 0;
     char *src = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
@@ -805,6 +835,10 @@ class EmuQp : public Qp {
   // assigned here, in posted order, under the same lock that orders
   // the match — delivery order == posted order by construction.
   int queue_recv(PostedRecv r) {
+    if (tel_on()) {
+      r.post_ns = tel_now_ns();
+      tel_emit(TDR_TEL_POST_RECV, eng_->tel_id, tel_id, r.wr_id, r.maxlen);
+    }
     std::unique_lock<std::mutex> lk(mu_);
     r.ticket = recv_head_++;
     if (!unexpected_.empty()) {
@@ -822,7 +856,7 @@ class EmuQp : public Qp {
       unexpected_.pop_front();
       lk.unlock();
       if (!u.fb) {
-        complete_recv(r.ticket,
+        complete_recv(r,
                       deliver_buffer_wc(r, u.payload.data(),
                                         u.payload.size()));
       } else if (seal_) {
@@ -859,7 +893,7 @@ class EmuQp : public Qp {
     if (!fold_ok) {
       ack.status = TDR_WC_LOC_ACCESS_ERR;
       sent = send_frame(ack, nullptr, 0);
-      complete_recv(r.ticket,
+      complete_recv(r,
                     {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, u.len});
       return sent;
     }
@@ -874,9 +908,10 @@ class EmuQp : public Qp {
       // final.
       bool ok = par_cma_reduce2(peer_pid_, r.dst, u.src_va, u.len, r.dtype,
                                 r.red_op);
+      if (ok) tel(TDR_TEL_FOLD, u.seq, u.len);
       ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
       sent = send_frame(ack, nullptr, 0);
-      complete_recv(r.ticket,
+      complete_recv(r,
                     {r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
                      TDR_OP_RECV, u.len});
       return sent;
@@ -887,10 +922,11 @@ class EmuQp : public Qp {
     // landing path (par_reduce, par_cma_reduce_from) uses the copy pool.
     par_reduce2_local(r.dst, u.payload.data(),
                       u.len / dtype_size(r.dtype), r.dtype, r.red_op);
+    tel(TDR_TEL_FOLD, u.seq, u.len);
     ack.status = TDR_WC_SUCCESS;
     ack.len = u.len;
     sent = send_frame(ack, u.payload.data(), u.payload.size());
-    complete_recv(r.ticket, {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
+    complete_recv(r, {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
     return sent;
   }
 
@@ -913,12 +949,13 @@ class EmuQp : public Qp {
     if (!fold_ok) {
       ack.status = TDR_WC_LOC_ACCESS_ERR;
       bool sent = send_frame(ack, nullptr, 0);
-      complete_recv(r.ticket,
+      complete_recv(r,
                     {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, u.len});
       return sent;
     }
     par_reduce2_local(r.dst, u.payload.data(),
                       u.len / dtype_size(r.dtype), r.dtype, r.red_op);
+    tel(TDR_TEL_FOLD, u.seq, u.len);
     ack.status = TDR_WC_SUCCESS;
     ack.len = u.len;
     SealTrailer t{};
@@ -928,7 +965,7 @@ class EmuQp : public Qp {
     t.crc = seal_crc(t, ack, u.payload.data(), u.len);
     seal_count(kSealSealed);
     bool sent = send_frame(ack, u.payload.data(), u.payload.size(), &t);
-    complete_recv(r.ticket, {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
+    complete_recv(r, {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
     return sent;
   }
 
@@ -960,6 +997,7 @@ class EmuQp : public Qp {
         t.gen != static_cast<uint32_t>(local))
       ok = false;
     seal_count(ok ? kSealVerified : kSealFailed);
+    tel(ok ? TDR_TEL_VERIFY_OK : TDR_TEL_VERIFY_FAIL, h.seq, len);
     *ok_out = ok;
     return true;
   }
@@ -979,10 +1017,13 @@ class EmuQp : public Qp {
       return {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
     DmaGuard guard{r.mr};
     (void)guard;
-    if (r.is_reduce)
+    tel(TDR_TEL_LAND, r.wr_id, len);
+    if (r.is_reduce) {
       par_reduce(r.dst, data, len / dtype_size(r.dtype), r.dtype, r.red_op);
-    else
+      tel(TDR_TEL_FOLD, r.wr_id, len);
+    } else {
       par_memcpy(r.dst, data, len);
+    }
     return {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, len};
   }
 
@@ -1001,6 +1042,7 @@ class EmuQp : public Qp {
     }
     DmaGuard guard{r.mr};
     (void)guard;
+    tel(TDR_TEL_LAND, r.wr_id, len);
     if (!r.is_reduce) {
       if (!read_full(fd_, r.dst, len)) return false;
     } else {
@@ -1016,6 +1058,7 @@ class EmuQp : public Qp {
         dst += chunk;
         left -= chunk;
       }
+      tel(TDR_TEL_FOLD, r.wr_id, len);
     }
     *wc = {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, len};
     return true;
@@ -1036,11 +1079,14 @@ class EmuQp : public Qp {
     }
     DmaGuard guard{r.mr};
     (void)guard;
+    tel(TDR_TEL_LAND, r.wr_id, len);
     bool ok;
-    if (!r.is_reduce)
+    if (!r.is_reduce) {
       ok = par_cma_copy_from(peer_pid_, r.dst, src, len);
-    else
+    } else {
       ok = par_cma_reduce_from(peer_pid_, r.dst, src, len, r.dtype, r.red_op);
+      if (ok) tel(TDR_TEL_FOLD, r.wr_id, len);
+    }
     *wc = {r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
            TDR_OP_RECV, len};
     return ok;
@@ -1122,23 +1168,28 @@ class EmuQp : public Qp {
                        EmuMr *mr, uint8_t wire_op = 0,
                        const char *src = nullptr, uint64_t raddr = 0,
                        uint32_t rkey = 0) {
+    PendingOp p{wr_id, opcode, dst, len, mr, wire_op, src, raddr, rkey, 0};
+    if (tel_on()) p.post_ns = tel_now_ns();
     std::lock_guard<std::mutex> g(mu_);
     uint64_t seq = next_seq_++;
-    pending_[seq] = {wr_id, opcode, dst, len, mr, wire_op, src, raddr, rkey};
+    pending_[seq] = p;
     return seq;
   }
 
   static void release_pending_mr(EmuMr *mr) { EmuEngine::dma_done(mr); }
 
   int fail_pending(uint64_t seq) {
-    std::lock_guard<std::mutex> g(mu_);
+    std::unique_lock<std::mutex> lk(mu_);
     auto it = pending_.find(seq);
     if (it != pending_.end()) {
-      cq_.push_back({it->second.wr_id, TDR_WC_FLUSH_ERR,
-                     it->second.opcode, 0});
+      tdr_wc wc{it->second.wr_id, TDR_WC_FLUSH_ERR, it->second.opcode, 0};
+      uint64_t post_ns = it->second.post_ns;
+      cq_.push_back(wc);
       release_pending_mr(it->second.mr);
       pending_.erase(it);
       cv_.notify_all();
+      lk.unlock();
+      tel_wc(wc.wr_id, wc.status, 0, post_ns);
     }
     set_error("post: connection down");
     return -1;
@@ -1166,6 +1217,7 @@ class EmuQp : public Qp {
   // corruption flips the CRC instead.
   bool send_frame_sealed(FrameHdr h, const char *src, size_t len, bool desc,
                          uint64_t wr_id) {
+    tel(TDR_TEL_WIRE_TX, h.seq, len);
     if (!seal_)
       return desc ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
     SealTrailer t{};
@@ -1193,9 +1245,13 @@ class EmuQp : public Qp {
   // stuck in a NAK/retransmit cycle holds back the delivery (not the
   // landing) of later chunks' completions, preserving the FIFO
   // completion order the ring schedules assert.
-  void complete_recv(uint64_t ticket, tdr_wc wc) {
+  void complete_recv(const PostedRecv &r, tdr_wc wc) {
+    // The WC event fires when the completion is RECORDED; CQ delivery
+    // may still be withheld behind an earlier ticket (posted-order
+    // contract) — the timeline shows the truth, not the FIFO.
+    tel_wc(wc.wr_id, wc.status, wc.len, r.post_ns);
     std::lock_guard<std::mutex> g(mu_);
-    recv_done_[ticket] = wc;
+    recv_done_[r.ticket] = wc;
     drain_recv_done_locked();
     cv_.notify_all();
   }
@@ -1210,6 +1266,7 @@ class EmuQp : public Qp {
   }
 
   void push_wc(tdr_wc wc) {
+    tel_wc(wc.wr_id, wc.status, wc.len, 0);
     std::lock_guard<std::mutex> g(mu_);
     cq_.push_back(wc);
     cv_.notify_all();
@@ -1255,7 +1312,7 @@ class EmuQp : public Qp {
       }
       release_recv(r);
       bool sent = send_frame(ack, nullptr, 0);
-      complete_recv(r.ticket, wc);
+      complete_recv(r, wc);
       return sent;
     }
     // Unexpected message: materialize it now. In desc mode the
@@ -1294,10 +1351,10 @@ class EmuQp : public Qp {
     }
     if (have2) {
       if (ok)
-        complete_recv(r2.ticket,
+        complete_recv(r2,
                       deliver_buffer_wc(r2, buf.data(), buf.size()));
       else
-        complete_recv(r2.ticket,
+        complete_recv(r2,
                       {r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
       release_recv(r2);
     }
@@ -1374,7 +1431,7 @@ class EmuQp : public Qp {
         retx_attempts_.erase(h.seq);
       }
       bool sent = send_frame(ack, nullptr, 0);
-      complete_recv(r.ticket,
+      complete_recv(r,
                     {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
       release_recv(r);
       return sent;
@@ -1387,6 +1444,7 @@ class EmuQp : public Qp {
       // verification read of r.dst.
       DmaGuard guard{r.mr};
       (void)guard;
+      tel(TDR_TEL_LAND, h.seq, h.len);
       if (desc) {
         moved = h.len == 0 ||
                 par_cma_copy_from(peer_pid_, r.dst, h.aux, h.len);
@@ -1413,7 +1471,7 @@ class EmuQp : public Qp {
       }
       ack.status = moved ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
       bool sent = send_frame(ack, nullptr, 0);
-      complete_recv(r.ticket,
+      complete_recv(r,
                     {r.wr_id,
                      moved ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
                      TDR_OP_RECV, h.len});
@@ -1428,6 +1486,7 @@ class EmuQp : public Qp {
       else retx_attempts_.erase(h.seq);
     }
     if (att <= seal_budget_) {
+      tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
       FrameHdr nak{};
       nak.op = OP_NAK;
       nak.seq = h.seq;
@@ -1435,7 +1494,7 @@ class EmuQp : public Qp {
     }
     ack.status = TDR_WC_INTEGRITY_ERR;
     bool sent = send_frame(ack, nullptr, 0);
-    complete_recv(r.ticket,
+    complete_recv(r,
                   {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len});
     release_recv(r);
     return sent;
@@ -1549,7 +1608,7 @@ class EmuQp : public Qp {
       ack.status = TDR_WC_GENERAL_ERR;
       bool sent = send_frame(ack, nullptr, 0);
       if (have) {
-        complete_recv(r.ticket,
+        complete_recv(r,
                       {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
         release_recv(r);
       }
@@ -1562,6 +1621,7 @@ class EmuQp : public Qp {
     PostedRecv r{};
     bool have = false, was_parked = false, send_nak = false,
          give_up = false, ack_now = false;
+    int att = 0;
     {
       std::lock_guard<std::mutex> g(mu_);
       Unexpected *ph = nullptr;
@@ -1608,7 +1668,7 @@ class EmuQp : public Qp {
           ack_now = !fb;
         }
       } else {
-        int att = ++retx_attempts_[h.seq];
+        att = ++retx_attempts_[h.seq];
         if (att <= seal_budget_) {
           send_nak = true;
           if (have && !was_parked) parked_[h.seq] = r;
@@ -1650,7 +1710,7 @@ class EmuQp : public Qp {
       tdr_wc wc = deliver_buffer_wc(r, buf.data(), h.len);
       ack.status = TDR_WC_SUCCESS;
       bool sent = send_frame(ack, nullptr, 0);
-      complete_recv(r.ticket, wc);
+      complete_recv(r, wc);
       release_recv(r);
       return sent;
     }
@@ -1659,6 +1719,7 @@ class EmuQp : public Qp {
       return send_frame(ack, nullptr, 0);
     }
     if (send_nak) {
+      tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
       FrameHdr nak{};
       nak.op = OP_NAK;
       nak.seq = h.seq;
@@ -1668,7 +1729,7 @@ class EmuQp : public Qp {
       ack.status = TDR_WC_INTEGRITY_ERR;
       bool sent = send_frame(ack, nullptr, 0);
       if (have) {
-        complete_recv(r.ticket,
+        complete_recv(r,
                       {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len});
         release_recv(r);
       }
@@ -1697,6 +1758,7 @@ class EmuQp : public Qp {
       return send_frame(ack, nullptr, 0);
     }
     bool moved;
+    tel(TDR_TEL_LAND, h.seq, h.len);
     if (desc) {
       moved = par_cma_copy_from(peer_pid_, dst, h.aux, h.len);
     } else {
@@ -1730,6 +1792,7 @@ class EmuQp : public Qp {
         att = ++retx_attempts_[h.seq];
       }
       if (att <= seal_budget_) {
+        tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
         FrameHdr nak{};
         nak.op = OP_NAK;
         nak.seq = h.seq;
@@ -1758,6 +1821,21 @@ class EmuQp : public Qp {
   void progress_loop() {
     FrameHdr h;
     while (read_full(fd_, &h, sizeof(h))) {
+      if (tel_on()) {
+        switch (h.op) {
+          case OP_WRITE:
+          case OP_WRITE_DESC:
+          case OP_SEND:
+          case OP_SEND_DESC:
+          case OP_SEND_FB:
+          case OP_SEND_FB_DESC:
+          case OP_READ_RESP:
+            tel_emit(TDR_TEL_WIRE_RX, eng_->tel_id, tel_id, h.seq, h.len);
+            break;
+          default:
+            break;
+        }
+      }
       switch (h.op) {
         case OP_WRITE: {
           if (seal_) {
@@ -1906,6 +1984,7 @@ class EmuQp : public Qp {
           }
           if (have) {
             seal_count(kSealRetx);
+            tel(TDR_TEL_RETX, h.seq, p.len);
             FrameHdr rh{};
             rh.op = p.wire_op;
             rh.status = 1;  // retransmission marker
@@ -2019,17 +2098,20 @@ class EmuQp : public Qp {
     dead_ = true;
     for (auto &kv : pending_) {
       cq_.push_back({kv.second.wr_id, TDR_WC_FLUSH_ERR, kv.second.opcode, 0});
+      tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns);
       release_pending_mr(kv.second.mr);
     }
     pending_.clear();
     for (auto &r : recvs_) {
       recv_done_[r.ticket] = {r.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
+      tel_wc(r.wr_id, TDR_WC_FLUSH_ERR, 0, r.post_ns);
       release_recv(r);
     }
     recvs_.clear();
     for (auto &kv : parked_) {
       recv_done_[kv.second.ticket] =
           {kv.second.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
+      tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns);
       release_recv(kv.second);
     }
     parked_.clear();
@@ -2039,14 +2121,17 @@ class EmuQp : public Qp {
   }
 
   void complete_pending(uint64_t seq, uint8_t status, char *, uint64_t) {
-    std::lock_guard<std::mutex> g(mu_);
+    std::unique_lock<std::mutex> lk(mu_);
     auto it = pending_.find(seq);
     if (it == pending_.end()) return;
-    cq_.push_back({it->second.wr_id, status, it->second.opcode,
-                   it->second.len});
+    tdr_wc wc{it->second.wr_id, status, it->second.opcode, it->second.len};
+    uint64_t post_ns = it->second.post_ns;
+    cq_.push_back(wc);
     release_pending_mr(it->second.mr);
     pending_.erase(it);
     cv_.notify_all();
+    lk.unlock();
+    tel_wc(wc.wr_id, wc.status, wc.len, post_ns);
   }
 
   EmuEngine *eng_;

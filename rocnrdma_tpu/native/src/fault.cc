@@ -296,6 +296,30 @@ uint64_t fault_clause_seen(size_t idx) {
   return idx < g_clauses.size() ? g_clauses[idx].seen : 0;
 }
 
+void fault_totals(uint64_t *seen, uint64_t *hits) {
+  ensure_parsed();
+  std::lock_guard<std::mutex> g(g_mu);
+  uint64_t s = 0, h = 0;
+  for (const auto &c : g_clauses) {
+    s += c.seen;
+    h += c.hits;
+  }
+  if (seen) *seen = s;
+  if (hits) *hits = h;
+}
+
+uint64_t fault_total_hits() {
+  uint64_t h = 0;
+  fault_totals(nullptr, &h);
+  return h;
+}
+
+uint64_t fault_total_seen() {
+  uint64_t s = 0;
+  fault_totals(&s, nullptr);
+  return s;
+}
+
 void fault_plan_reset() {
   std::lock_guard<std::mutex> g(g_mu);
   parse_locked();

@@ -13,6 +13,7 @@
 // behind the ALU.
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -90,6 +91,41 @@ constexpr uint64_t kWrRecv = 0x5245ull << 48;
 constexpr uint64_t kWrSend = 0x5345ull << 48;
 constexpr uint64_t kWrKindMask = 0xffffull << 48;
 
+// Flight recorder: per-collective call ordinal (process-wide) so
+// ring_begin/ring_end pair up in the exported timeline.
+std::atomic<uint64_t> g_ring_call_seq{0};
+
+// Bracket one collective call: RING_BEGIN/RING_END events plus the
+// whole-collective latency and bandwidth histograms. Zero-cost when
+// telemetry is off (the ctor takes the one-branch guard and leaves
+// every field 0). Return paths route through finish(rc) to record
+// the true status; the destructor is the backstop — a path that
+// skips finish still emits a (failed) RING_END, so begin/end events
+// always pair in exported timelines.
+struct RingTelScope {
+  uint16_t eng = 0;
+  uint64_t seq = 0;
+  uint64_t nbytes = 0;
+  uint64_t t0 = 0;
+  bool done = false;
+  RingTelScope(tdr_ring *r, uint64_t bytes);
+  void record(int rc) {
+    done = true;
+    uint64_t dt_ns = tdr::tel_now_ns() - t0;
+    tdr::tel_emit(TDR_TEL_RING_END, eng, 0, seq, rc == 0 ? 0 : 1);
+    tdr::tel_hist_add(TDR_HIST_RING_LAT_US, dt_ns / 1000);
+    if (rc == 0 && dt_ns > 0)
+      tdr::tel_hist_add(TDR_HIST_RING_MBPS, nbytes * 1000 / dt_ns);
+  }
+  int finish(int rc) {
+    if (t0 && !done) record(rc);
+    return rc;
+  }
+  ~RingTelScope() {
+    if (t0 && !done) record(-1);
+  }
+};
+
 }  // namespace
 
 struct tdr_ring {
@@ -138,6 +174,17 @@ struct tdr_ring {
     return tmp_mr;
   }
 };
+
+namespace {
+RingTelScope::RingTelScope(tdr_ring *r, uint64_t bytes) {
+  if (!tdr::tel_on()) return;
+  eng = reinterpret_cast<tdr::Engine *>(r->eng)->tel_id;
+  seq = g_ring_call_seq.fetch_add(1, std::memory_order_relaxed) + 1;
+  nbytes = bytes;
+  t0 = tdr::tel_now_ns();
+  tdr::tel_emit(TDR_TEL_RING_BEGIN, eng, 0, seq, nbytes);
+}
+}  // namespace
 
 extern "C" {
 
@@ -785,13 +832,14 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
   std::lock_guard<std::mutex> g(r->mu);
   const int world = r->world;
   const size_t nbytes = count * esz;
+  RingTelScope tel(r, nbytes);
 
   std::vector<size_t> seg_off, seg_len;
   seg_layout(world, count, esz, &seg_off, &seg_len);
 
   bool owned = false;
   tdr_mr *dmr = r->data_mr(data, nbytes, &owned);
-  if (!dmr) return -1;
+  if (!dmr) return tel.finish(-1);
   if (!tdr_mr_cpu_foldable(dmr)) {
     // EVERY schedule folds host-side somewhere (recv_reduce slots or
     // the scratch window into the data pointer) — over a CPU-less
@@ -804,7 +852,7 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
         "ring_allreduce: data MR has no CPU mapping (verbs dma-buf); "
         "host-side reduction is impossible — register CPU-visible "
         "memory or use a host-staged collective");
-    return -1;
+    return tel.finish(-1);
   }
   OwnedMrGuard guard{dmr, owned};
   (void)guard;
@@ -835,7 +883,7 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
     // both ranks take the same branch here by construction.
     f.use_fb = tdr_qp_has_send_foldback(r->right);
     r->last_sched = f.use_fb ? TDR_SCHED_FUSED2_FB : TDR_SCHED_FUSED2;
-    return f.run();
+    return tel.finish(f.run());
   }
 
   // General wavefront path: the full 2(world-1)-step schedule
@@ -902,13 +950,13 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                             fold, 0});
     }
     r->last_sched = TDR_SCHED_WAVEFRONT;
-    return wf.run();
+    return tel.finish(wf.run());
   }
 
   r->last_sched = TDR_SCHED_GENERIC;
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};
-  if (run_rs_phase(pipe, r, seg_off, seg_len) != 0) return -1;
-  return run_ag_phase(pipe, r, seg_off, seg_len);
+  if (run_rs_phase(pipe, r, seg_off, seg_len) != 0) return tel.finish(-1);
+  return tel.finish(run_ag_phase(pipe, r, seg_off, seg_len));
 }
 
 // ------------------------------------------------------------------
@@ -950,17 +998,18 @@ int tdr_ring_reduce_scatter(tdr_ring *r, void *data, size_t count,
   if (own_off) *own_off = seg_off[own];
   if (own_len) *own_len = seg_len[own];
   if (count == 0 || world == 1) return 0;
+  RingTelScope tel(r, count * esz);
   bool owned = false;
   tdr_mr *dmr = r->data_mr(data, count * esz, &owned);
-  if (!dmr) return -1;
+  if (!dmr) return tel.finish(-1);
   OwnedMrGuard guard{dmr, owned};
   (void)guard;
   if (!tdr_mr_cpu_foldable(dmr)) {
     tdr::set_error("ring_reduce_scatter: data MR has no CPU mapping");
-    return -1;
+    return tel.finish(-1);
   }
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};
-  return run_rs_phase(pipe, r, seg_off, seg_len);
+  return tel.finish(run_rs_phase(pipe, r, seg_off, seg_len));
 }
 
 int tdr_ring_all_gather(tdr_ring *r, void *data, size_t count, int dtype) {
@@ -979,13 +1028,14 @@ int tdr_ring_all_gather(tdr_ring *r, void *data, size_t count, int dtype) {
   if (world == 1) return 0;
   std::vector<size_t> seg_off, seg_len;
   seg_layout(world, count, esz, &seg_off, &seg_len);
+  RingTelScope tel(r, count * esz);
   bool owned = false;
   tdr_mr *dmr = r->data_mr(data, count * esz, &owned);
-  if (!dmr) return -1;
+  if (!dmr) return tel.finish(-1);
   OwnedMrGuard guard{dmr, owned};
   (void)guard;
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, TDR_RED_SUM, esz};
-  return run_ag_phase(pipe, r, seg_off, seg_len);
+  return tel.finish(run_ag_phase(pipe, r, seg_off, seg_len));
 }
 
 namespace {
@@ -1102,21 +1152,22 @@ int tdr_ring_reduce(tdr_ring *r, void *data, size_t count, int dtype,
   }
   if (count == 0 || world == 1) return 0;
   const size_t nbytes = count * esz;
+  RingTelScope tel(r, nbytes);
   bool owned = false;
   tdr_mr *dmr = r->data_mr(data, nbytes, &owned);
-  if (!dmr) return -1;
+  if (!dmr) return tel.finish(-1);
   OwnedMrGuard guard{dmr, owned};
   (void)guard;
   if (!tdr_mr_cpu_foldable(dmr)) {
     tdr::set_error("ring_reduce: data MR has no CPU mapping");
-    return -1;
+    return tel.finish(-1);
   }
   if (!tdr_qp_has_recv_reduce(r->left)) {
     // Only the RECEIVING side needs the fused op (a plain SEND
     // matches a posted recv_reduce fine); both in-repo engines
     // advertise it, so this guards future engines only.
     tdr::set_error("ring_reduce: engine lacks reduce-on-receive");
-    return -1;
+    return tel.finish(-1);
   }
 
   // Converging fold toward root, rightward along the ring: the chain
@@ -1140,7 +1191,7 @@ int tdr_ring_reduce(tdr_ring *r, void *data, size_t count, int dtype,
                  /*send_win=*/reduce_recv_window(r->right),
                  /*head=*/d == 1,
                  "ring(reduce)"};
-  return pump.run(
+  return tel.finish(pump.run(
       [&](size_t i) {
         return tdr_post_recv_reduce(r->left, dmr, i * chunk, clen(i),
                                     dtype, red_op, kWrRecv | i);
@@ -1148,7 +1199,7 @@ int tdr_ring_reduce(tdr_ring *r, void *data, size_t count, int dtype,
       [&](size_t i) {
         return tdr_post_send(r->right, dmr, i * chunk, clen(i),
                              kWrSend | i);
-      });
+      }));
 }
 
 int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
@@ -1163,9 +1214,10 @@ int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
     return -1;
   }
   if (nbytes == 0 || world == 1) return 0;
+  RingTelScope tel(r, nbytes);
   bool owned = false;
   tdr_mr *dmr = r->data_mr(data, nbytes, &owned);
-  if (!dmr) return -1;
+  if (!dmr) return tel.finish(-1);
   OwnedMrGuard guard{dmr, owned};
   (void)guard;
 
@@ -1186,7 +1238,7 @@ int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
                  /*send_win=*/kMaxOutstanding,
                  /*head=*/d == 0,
                  "ring(bcast)"};
-  return pump.run(
+  return tel.finish(pump.run(
       [&](size_t i) {
         return tdr_post_recv(r->left, dmr, i * chunk, clen(i),
                              kWrRecv | i);
@@ -1194,7 +1246,7 @@ int tdr_ring_broadcast(tdr_ring *r, void *data, size_t nbytes, int root) {
       [&](size_t i) {
         return tdr_post_send(r->right, dmr, i * chunk, clen(i),
                              kWrSend | i);
-      });
+      }));
 }
 
 namespace {
@@ -1240,6 +1292,7 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
     return -1;
   }
   if (count == 0 || world == 1) return 0;
+  RingTelScope tel(r, count * esz);
   const size_t segsz = count / world * esz;
   const int rank = r->rank;
   const size_t steps = static_cast<size_t>(world) - 1;
@@ -1267,11 +1320,11 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
       owned = true;
       roff = 0;
     }
-    if (!dmr) return -1;
+    if (!dmr) return tel.finish(-1);
     OwnedMrGuard guard{dmr, owned};
     (void)guard;
     tdr_mr *smr = r->scratch(segsz);
-    if (!smr) return -1;
+    if (!smr) return tel.finish(-1);
     std::memcpy(r->tmp.data(), db + peer * segsz, segsz);
     ChainPump pump{r, /*n_recv=*/1, /*n_send=*/1, 1, 1, /*head=*/true,
                    "ring(alltoall2)"};
@@ -1283,7 +1336,7 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
           return tdr_post_send(r->right, smr, 0, segsz, kWrSend | 0);
         });
     if (rc == 0) release_big_scratch(r, segsz);
-    return rc;
+    return tel.finish(rc);
   }
   // No data MR on the general path: the user buffer never touches the
   // wire — bundles stage through the scratch MR and the buffer is
@@ -1299,7 +1352,7 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
     total += (steps - ri) * segsz;
   }
   tdr_mr *smr = r->scratch(total);
-  if (!smr) return -1;
+  if (!smr) return tel.finish(-1);
   char *sb = r->tmp.data();
   char *db = static_cast<char *>(data);
 
@@ -1328,7 +1381,7 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
         return tdr_post_send(r->right, smr, off, (steps - i) * segsz,
                              kWrSend | i);
       });
-  if (rc != 0) return rc;
+  if (rc != 0) return tel.finish(rc);
 
   // Keep every bundle head: recv step ri carried the segment from
   // src (rank-1-ri) mod world addressed to this rank.
@@ -1339,7 +1392,7 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
                 segsz);
   }
   release_big_scratch(r, total);
-  return 0;
+  return tel.finish(0);
 }
 
 }  // extern "C"

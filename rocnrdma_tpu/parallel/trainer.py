@@ -268,12 +268,18 @@ class Trainer:
     def _step_once(self, tokens) -> float:
         """One optimizer step; returns the (pre-update) loss."""
         tokens = self.shard_batch(tokens)
+        step_no = self.global_step + 1
         # _trace_ctx matters only on the first call (trace time); it is
         # a no-op for steady-state dispatch of the compiled step.
+        # The phase spans (grads / sync / apply) are the top bars of
+        # the flight-recorder timeline: under trainer.sync sit the
+        # xslice.sync and world.allreduce spans, and under those the
+        # native chunk events down to individual retransmits.
         with self.mesh, self._trace_ctx():
             if self.cross_slice_sync is None:
-                self.params, self.opt_state, loss = self._jit_full(
-                    self.params, self.opt_state, tokens)
+                with trace.span("trainer.fused_step", step=step_no):
+                    self.params, self.opt_state, loss = self._jit_full(
+                        self.params, self.opt_state, tokens)
             else:
                 if self._stamp_sync:
                     stamp = getattr(self.cross_slice_sync,
@@ -281,11 +287,13 @@ class Trainer:
                     if stamp is not None:
                         stamp(self.global_step)
                     self._stamp_sync = False
-                loss, grads = self._jit_grads(self.params, tokens)
+                with trace.span("trainer.grads", step=step_no):
+                    loss, grads = self._jit_grads(self.params, tokens)
                 # The cross-slice hop: grads averaged across slices
                 # over the RDMA transport (staged fallback accounts
                 # its bytes), then applied locally.
-                grads = self.cross_slice_sync(grads)
+                with trace.span("trainer.sync", step=step_no):
+                    grads = self.cross_slice_sync(grads)
                 # Quarantine check BEFORE apply: gradients that passed
                 # the transport's integrity seal but came back
                 # non-finite would poison params on apply — with the
@@ -297,9 +305,10 @@ class Trainer:
                         and not self._grads_finite(grads)):
                     raise _NonFiniteGrads(
                         f"all-reduced gradients contain non-finite "
-                        f"values at step {self.global_step + 1}")
-                self.params, self.opt_state = self._jit_apply(
-                    self.params, self.opt_state, grads)
+                        f"values at step {step_no}")
+                with trace.span("trainer.apply", step=step_no):
+                    self.params, self.opt_state = self._jit_apply(
+                        self.params, self.opt_state, grads)
         return float(loss)
 
     @staticmethod

@@ -1,0 +1,110 @@
+"""Chrome/Perfetto trace export.
+
+Emits the Chrome Trace Event JSON format (the ``traceEvents`` array),
+which https://ui.perfetto.dev opens directly. Track mapping:
+
+- **pid** = native engine track id (one "process" per rank/engine; the
+  ``engine_labels`` argument names them, e.g. ``{1: "rank0/emu"}``).
+  Python-tier events ride pid 0, labeled "python".
+- **tid** = native QP track id (one "thread" per QP; 0 = engine-level
+  events like ring_begin/ring_end, or the python tier).
+
+Native chunk-lifecycle events render as instants carrying
+``{"id", "arg"}`` args (id = wr_id/frame seq — follow one chunk's
+post → tx → rx → land → verify → nak → retx → wc across the two
+ranks' tracks by its id). Python ``trace.span`` events (those with a
+``dur_s`` field) render as complete ("X") slices, so a trainer step
+or a collective call appears as a bar over the chunk instants it
+contains.
+
+The export is DETERMINISTIC for a given event list: events are sorted
+by (ts, pid, tid, name, id) and serialized with sorted keys, so the
+same recording always produces byte-identical JSON (the
+replay-stability contract tests pin).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from rocnrdma_tpu.telemetry.recorder import TelEvent, timeline
+
+
+def _meta(pid: int, tid: Optional[int], name: str) -> Dict[str, Any]:
+    ev: Dict[str, Any] = {
+        "ph": "M", "pid": pid, "ts": 0,
+        "name": "process_name" if tid is None else "thread_name",
+        "args": {"name": name},
+    }
+    if tid is not None:
+        ev["tid"] = tid
+    return ev
+
+
+def export_trace(path: Optional[str] = None,
+                 events: Optional[List[TelEvent]] = None,
+                 include_python: bool = True,
+                 engine_labels: Optional[Dict[int, str]] = None
+                 ) -> Dict[str, Any]:
+    """Build (and optionally write) a Perfetto-loadable trace dict.
+
+    ``events``: a merged timeline from ``telemetry.timeline()``; when
+    None, the native ring is drained and merged with the Python tracer
+    now. ``engine_labels`` names the per-engine process tracks (e.g.
+    ``{world.engine.telemetry_id: f"rank{world.rank}"}``)."""
+    if events is None:
+        events = timeline(include_python=include_python)
+    labels = engine_labels or {}
+
+    trace_events: List[Dict[str, Any]] = []
+    seen_pids: Dict[int, None] = {}
+    seen_tids: Dict[tuple, None] = {}
+
+    for ev in sorted(events, key=lambda e: (e.ts_ns, e.engine, e.qp,
+                                            e.name, e.id)):
+        ts_us = ev.ts_ns / 1000.0
+        pid = ev.engine if ev.source == "native" else 0
+        tid = ev.qp if ev.source == "native" else 0
+        seen_pids.setdefault(pid)
+        seen_tids.setdefault((pid, tid))
+        if ev.source == "python" and "dur_s" in ev.fields:
+            dur_us = float(ev.fields["dur_s"]) * 1e6
+            args = {k: v for k, v in ev.fields.items() if k != "dur_s"}
+            trace_events.append({
+                "name": ev.name, "ph": "X", "pid": pid, "tid": tid,
+                "ts": ts_us - dur_us, "dur": dur_us, "args": args,
+            })
+            continue
+        args: Dict[str, Any]
+        if ev.source == "native":
+            args = {"id": ev.id, "arg": ev.arg}
+        else:
+            args = dict(ev.fields)
+        trace_events.append({
+            "name": ev.name, "ph": "i", "s": "t", "pid": pid, "tid": tid,
+            "ts": ts_us, "args": args,
+        })
+
+    meta: List[Dict[str, Any]] = []
+    for pid in sorted(seen_pids):
+        label = labels.get(pid, "python" if pid == 0 else f"engine{pid}")
+        meta.append(_meta(pid, None, label))
+    for pid, tid in sorted(seen_tids):
+        name = ("engine" if tid == 0 else f"qp{tid}") \
+            if pid != 0 else "tracer"
+        meta.append(_meta(pid, tid, name))
+
+    doc = {
+        "displayTimeUnit": "ms",
+        "traceEvents": meta + trace_events,
+    }
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+    return doc
+
+
+def dumps(doc: Dict[str, Any]) -> str:
+    """The canonical (deterministic) serialization of an export."""
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))

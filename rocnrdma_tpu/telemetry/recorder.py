@@ -1,0 +1,223 @@
+"""Flight-recorder access: drain, merge, histogram math, snapshots.
+
+Everything here is a thin, dependency-free layer over the native C API
+(``transport.engine`` ctypes) plus the Python tracer. The native ring
+is DRAINED destructively (flight-recorder semantics — the consumer
+owns what it read); callers that need to export the same window twice
+drain once into a list and pass it around.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from rocnrdma_tpu.utils.trace import trace
+
+
+@dataclass(frozen=True)
+class TelEvent:
+    """One timeline event, native or Python, in the shared
+    CLOCK_MONOTONIC nanosecond domain."""
+
+    ts_ns: int
+    name: str
+    engine: int = 0      # native engine track (0 = none / python tier)
+    qp: int = 0          # native qp track (0 = none)
+    id: int = 0          # wr_id / frame seq / call seq
+    arg: int = 0         # bytes / status / attempt (per event type)
+    source: str = "native"
+    fields: Dict[str, Any] = field(default_factory=dict)
+
+
+def enabled() -> bool:
+    from rocnrdma_tpu.transport import engine as eng
+
+    return eng.telemetry_enabled()
+
+
+def enable(ring: Optional[int] = None) -> None:
+    """Turn the native flight recorder on (sets TDR_TELEMETRY and
+    resets the ring — recording starts empty)."""
+    from rocnrdma_tpu.transport import engine as eng
+
+    os.environ["TDR_TELEMETRY"] = "1"
+    if ring is not None:
+        os.environ["TDR_TELEMETRY_RING"] = str(int(ring))
+    eng.telemetry_reset()
+
+
+def disable() -> None:
+    """Turn recording off (event sites drop back to one branch)."""
+    from rocnrdma_tpu.transport import engine as eng
+
+    os.environ["TDR_TELEMETRY"] = "0"
+    eng.telemetry_reset()
+
+
+def reset() -> None:
+    """Clear the ring/histograms without changing the on/off state."""
+    from rocnrdma_tpu.transport import engine as eng
+
+    eng.telemetry_reset()
+
+
+_event_names: Dict[int, str] = {}
+
+
+def _event_name(eng, ev_type: int) -> str:
+    # Cached: the type table is ~18 constants; one FFI call per
+    # drained event would dominate a full-ring drain after a soak.
+    name = _event_names.get(ev_type)
+    if name is None:
+        name = _event_names[ev_type] = eng.telemetry_event_name(ev_type)
+    return name
+
+
+def drain(max_events: int = 1 << 20) -> List[TelEvent]:
+    """Remove and return native events, oldest first."""
+    from rocnrdma_tpu.transport import engine as eng
+
+    out = []
+    for raw in eng.telemetry_drain(max_events):
+        out.append(TelEvent(
+            ts_ns=int(raw.ts_ns), name=_event_name(eng, raw.type),
+            engine=int(raw.engine), qp=int(raw.qp), id=int(raw.id),
+            arg=int(raw.arg), source="native"))
+    return out
+
+
+def python_events() -> List[TelEvent]:
+    """The Python tracer's ring as timeline events. time.monotonic()
+    and the native recorder read the same Linux clock, so the float
+    seconds convert straight to the shared nanosecond domain. Span
+    events (``dur_s`` field) keep it in ``fields`` for exporters to
+    render as durations."""
+    out = []
+    for ts, name, fields in trace.events():
+        out.append(TelEvent(ts_ns=int(ts * 1e9), name=name,
+                            source="python", fields=dict(fields)))
+    return out
+
+
+def timeline(include_python: bool = True,
+             native: Optional[Iterable[TelEvent]] = None) -> List[TelEvent]:
+    """One merged timeline: native events (drained now unless passed
+    in) and the Python tracer's ring, sorted on the shared clock."""
+    events = list(native) if native is not None else drain()
+    if include_python:
+        events.extend(python_events())
+    events.sort(key=lambda e: e.ts_ns)
+    return events
+
+
+def counters() -> Dict[str, int]:
+    """The unified native counter registry (integrity.*, fault.*,
+    copy.*, telemetry.*) plus the Python tracer's counters — one
+    namespace, native names winning on (non-existent) collisions."""
+    from rocnrdma_tpu.transport import engine as eng
+
+    out: Dict[str, int] = dict(trace.counters())
+    out.update(eng.native_counters())
+    return out
+
+
+def histograms() -> Dict[str, List[int]]:
+    from rocnrdma_tpu.transport import engine as eng
+
+    return eng.telemetry_histograms()
+
+
+# ------------------------------------------------------------ buckets
+
+def bucket_upper(b: int) -> int:
+    """Upper edge of log2 bucket ``b``: bucket 0 holds zeros; bucket b
+    (>=1) holds values v with v.bit_length() == b, i.e.
+    [2^(b-1), 2^b)."""
+    return 0 if b <= 0 else (1 << b) - 1
+
+
+def hist_percentile(buckets: Sequence[int], q: float) -> int:
+    """Percentile estimate from a log2 histogram — the UPPER edge of
+    the bucket containing the q-quantile (conservative for latencies:
+    the true value is <= the estimate). q in [0, 100]."""
+    total = sum(buckets)
+    if total == 0:
+        return 0
+    target = total * q / 100.0
+    acc = 0
+    for b, count in enumerate(buckets):
+        acc += count
+        if acc >= target and count:
+            return bucket_upper(b)
+    return bucket_upper(len(buckets) - 1)
+
+
+def hist_percentiles(buckets: Sequence[int],
+                     qs: Sequence[float] = (50, 90, 99)) -> Dict[str, int]:
+    return {f"p{q:g}": hist_percentile(buckets, q) for q in qs}
+
+
+def snapshot() -> Dict[str, Any]:
+    """Counters + histograms + latency percentiles in one JSONable
+    dict — what ``tdr_top`` renders and the bench record embeds."""
+    from rocnrdma_tpu.transport import engine as eng
+
+    hists = histograms()
+    return {
+        "enabled": enabled(),
+        "recorded": eng.telemetry_recorded(),
+        "dropped": eng.telemetry_dropped(),
+        "counters": counters(),
+        "histograms": hists,
+        "percentiles": {
+            name: hist_percentiles(buckets)
+            for name, buckets in hists.items()
+        },
+    }
+
+
+def start_snapshot_writer(path: str, interval_s: float = 1.0):
+    """Periodically write ``snapshot()`` to ``path`` (atomic rename)
+    from a daemon thread — the producer side of ``tdr_top --file``.
+    Returns an object with ``stop()``."""
+
+    class _Writer:
+        def __init__(self) -> None:
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="tdr-tel-snap")
+            self._thread.start()
+
+        def _run(self) -> None:
+            while not self._stop.is_set():
+                try:
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(snapshot(), f)
+                    os.replace(tmp, path)
+                except Exception:
+                    pass  # diagnostics must never take the workload down
+                self._stop.wait(interval_s)
+
+        def stop(self) -> None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+
+    return _Writer()
+
+
+def anchor() -> Dict[str, float]:
+    """Clock-domain anchor: the native and Python readings of the one
+    monotonic clock, taken back to back (tests assert they agree)."""
+    from rocnrdma_tpu.transport import engine as eng
+
+    py0 = time.monotonic()
+    native = eng.telemetry_now_ns()
+    py1 = time.monotonic()
+    return {"python_ns_lo": py0 * 1e9, "native_ns": float(native),
+            "python_ns_hi": py1 * 1e9}

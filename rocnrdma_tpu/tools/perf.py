@@ -202,10 +202,19 @@ def main(argv=None):
                     help="register plain host memory or fake-HBM pins")
     ap.add_argument("--json", action="store_true",
                     help="print a JSON summary line at the end")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="record the run in the native flight recorder "
+                         "and add log2-histogram latency percentiles "
+                         "to the JSON summary")
     args = ap.parse_args(argv)
 
     from rocnrdma_tpu.transport.engine import Engine
     from rocnrdma_tpu.utils.config import get_config
+
+    if args.telemetry:
+        from rocnrdma_tpu import telemetry
+
+        telemetry.enable()
 
     spec = args.engine or get_config().engine
     sizes = parse_sizes(args.sizes)
@@ -250,6 +259,19 @@ def main(argv=None):
             summary["min_lat_us"] = min(r["lat_us_min"] for r in results)
         else:
             summary["peak_GBps"] = max(r["GBps"] for r in results)
+        if args.telemetry:
+            from rocnrdma_tpu import telemetry
+
+            snap = telemetry.snapshot()
+            summary["telemetry"] = {
+                "events_recorded": snap["recorded"],
+                "events_dropped": snap["dropped"],
+                # Per-op post→completion latency from the native log2
+                # histogram (upper-edge estimates) — the engine-side
+                # view the wall-clock sweep above cannot see.
+                "chunk_lat_us": snap["percentiles"]["chunk_lat_us"],
+                "chunk_bytes": snap["percentiles"]["chunk_bytes"],
+            }
         print(json.dumps(summary))
     return 0
 

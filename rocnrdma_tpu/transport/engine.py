@@ -80,6 +80,19 @@ class Wc(ctypes.Structure):
     ]
 
 
+class TelEventC(ctypes.Structure):
+    """Mirror of the native tdr_tel_event (32 bytes, fixed layout)."""
+
+    _fields_ = [
+        ("ts_ns", ctypes.c_uint64),
+        ("type", ctypes.c_uint16),
+        ("engine", ctypes.c_uint16),
+        ("qp", ctypes.c_uint32),
+        ("id", ctypes.c_uint64),
+        ("arg", ctypes.c_uint64),
+    ]
+
+
 def _build_library() -> None:
     # TUNE=native is safe here: build-on-demand always runs on the
     # machine that will execute the library (the repo ships no .so).
@@ -217,6 +230,33 @@ def _declare(lib: ctypes.CDLL) -> None:
         P, P, ctypes.c_size_t, ctypes.c_int,
     ]
     lib.tdr_ring_destroy.argtypes = [P]
+    # Flight recorder (telemetry.cc): event ring, histograms, and the
+    # unified counter registry.
+    lib.tdr_tel_enabled.restype = ctypes.c_int
+    lib.tdr_tel_reset.restype = None
+    lib.tdr_tel_now_ns.restype = ctypes.c_uint64
+    lib.tdr_tel_drain.restype = ctypes.c_int
+    lib.tdr_tel_drain.argtypes = [ctypes.POINTER(TelEventC), ctypes.c_int]
+    lib.tdr_tel_recorded.restype = ctypes.c_uint64
+    lib.tdr_tel_dropped.restype = ctypes.c_uint64
+    lib.tdr_tel_event_name.restype = ctypes.c_char_p
+    lib.tdr_tel_event_name.argtypes = [ctypes.c_int]
+    lib.tdr_tel_hist_count.restype = ctypes.c_int
+    lib.tdr_tel_hist_name.restype = ctypes.c_char_p
+    lib.tdr_tel_hist_name.argtypes = [ctypes.c_int]
+    lib.tdr_tel_hist_read.restype = None
+    lib.tdr_tel_hist_read.argtypes = [ctypes.c_int,
+                                      ctypes.POINTER(ctypes.c_uint64)]
+    lib.tdr_tel_engine_id.restype = ctypes.c_int
+    lib.tdr_tel_engine_id.argtypes = [P]
+    lib.tdr_tel_qp_id.restype = ctypes.c_int
+    lib.tdr_tel_qp_id.argtypes = [P]
+    lib.tdr_counter_count.restype = ctypes.c_int
+    lib.tdr_counter_name.restype = ctypes.c_char_p
+    lib.tdr_counter_name.argtypes = [ctypes.c_int]
+    lib.tdr_counters_read.restype = ctypes.c_int
+    lib.tdr_counters_read.argtypes = [ctypes.POINTER(ctypes.c_uint64),
+                                      ctypes.c_int]
 
 
 # Completion statuses that signal a TRANSIENT condition — a peer died
@@ -299,6 +339,91 @@ def copy_counters() -> Tuple[int, int]:
 
 
 # ------------------------------------------------------------------
+# Flight recorder (native telemetry.cc): raw ctypes surface. The
+# ergonomic API — merged native+Python timelines, Perfetto export,
+# histogram percentiles — lives in rocnrdma_tpu.telemetry.
+
+def telemetry_enabled() -> bool:
+    """Whether the native flight recorder is recording (TDR_TELEMETRY
+    as the engine parsed it — one branch per event site when off)."""
+    return bool(_load().tdr_tel_enabled())
+
+
+def telemetry_reset() -> None:
+    """Re-read TDR_TELEMETRY / TDR_TELEMETRY_RING and clear the native
+    ring, histograms, and recorded/dropped counts (set the env, then
+    call this — the tdr_fault_plan_reset idiom)."""
+    _load().tdr_tel_reset()
+
+
+def telemetry_now_ns() -> int:
+    """The recorder's clock (CLOCK_MONOTONIC ns) — the same clock
+    Python's time.monotonic() reads on Linux, anchoring the merged
+    timeline's single clock domain."""
+    return int(_load().tdr_tel_now_ns())
+
+
+def telemetry_recorded() -> int:
+    return int(_load().tdr_tel_recorded())
+
+
+def telemetry_dropped() -> int:
+    return int(_load().tdr_tel_dropped())
+
+
+def telemetry_event_name(ev_type: int) -> str:
+    return _load().tdr_tel_event_name(ev_type).decode()
+
+
+def telemetry_drain(max_events: int = 65536) -> List[TelEventC]:
+    """Remove up to ``max_events`` events from the native ring, oldest
+    first (raw structs; rocnrdma_tpu.telemetry wraps them)."""
+    lib = _load()
+    out: List[TelEventC] = []
+    batch = 4096
+    while len(out) < max_events:
+        want = min(batch, max_events - len(out))
+        arr = (TelEventC * want)()
+        n = lib.tdr_tel_drain(arr, want)
+        out.extend(arr[i] for i in range(n))
+        if n < want:
+            break
+    return out
+
+
+def telemetry_histograms() -> dict:
+    """All native log2-bucket histograms: name -> 64 bucket counts
+    (bucket b counts values in [2^(b-1), 2^b); bucket 0 zeros)."""
+    lib = _load()
+    out = {}
+    for i in range(int(lib.tdr_tel_hist_count())):
+        buckets = (ctypes.c_uint64 * 64)()
+        lib.tdr_tel_hist_read(i, buckets)
+        out[lib.tdr_tel_hist_name(i).decode()] = [int(v) for v in buckets]
+    return out
+
+
+_counter_names: List[str] = []
+
+
+def native_counters() -> dict:
+    """One snapshot of the unified native counter registry
+    (integrity.*, fault.*, copy.*, telemetry.*) — a single native
+    call, so delta accounting has no multi-call double-count window.
+    Counters sharing a producer (fault seen/hits, copy tiers) are
+    read in one pass natively; cross-subsystem counters are
+    individually-atomic monotonic reads."""
+    lib = _load()
+    global _counter_names
+    if not _counter_names:
+        _counter_names = [lib.tdr_counter_name(i).decode()
+                          for i in range(int(lib.tdr_counter_count()))]
+    arr = (ctypes.c_uint64 * len(_counter_names))()
+    n = lib.tdr_counters_read(arr, len(_counter_names))
+    return {name: int(arr[i]) for i, name in enumerate(_counter_names[:n])}
+
+
+# ------------------------------------------------------------------
 # Fault-plan introspection (TDR_FAULT_PLAN, native fault.cc): tests and
 # the recovery layer read per-clause hit counters so an injected fault
 # is OBSERVABLE — asserted, traced, never assumed.
@@ -332,13 +457,15 @@ def note_fault_injections() -> int:
     """Emit a ``fault.injected`` trace event for hits since the last
     call (the recovery path calls this so injected faults show up in
     the same observable stream as ``world.rebuild``/``trainer.resume``).
-    Returns the number of new hits."""
-    total = sum(fault_plan_hits(i) for i in range(fault_plan_clauses()))
-    new = total - _fault_hits_noted[0]
-    if new > 0:
-        _fault_hits_noted[0] = total
-        trace.event("fault.injected", hits=new, total=total)
-    return max(new, 0)
+    Returns the number of new hits. Reads the native counter registry
+    — one snapshot, not a per-clause poll loop."""
+    with _note_lock:
+        total = native_counters()["fault.hits"]
+        new = total - _fault_hits_noted[0]
+        if new > 0:
+            _fault_hits_noted[0] = total
+            trace.event("fault.injected", hits=new, total=total)
+        return max(new, 0)
 
 
 # ------------------------------------------------------------------
@@ -365,8 +492,9 @@ def seal_counters() -> dict:
 
 def seal_counters_reset() -> None:
     _load().tdr_seal_counters_reset()
-    _integrity_noted.clear()
-    _integrity_noted.update({k: 0 for k in _SEAL_COUNTER_NAMES})
+    with _note_lock:
+        _integrity_noted.clear()
+        _integrity_noted.update({k: 0 for k in _SEAL_COUNTER_NAMES})
 
 
 def seal_retry_budget() -> int:
@@ -377,24 +505,33 @@ def seal_retry_budget() -> int:
 
 
 _integrity_noted = {k: 0 for k in _SEAL_COUNTER_NAMES}
+# Serializes the delta accounting of note_integrity and
+# note_fault_injections: the old poll-then-add bridge could run the
+# native read and the noted-state update in two racing callers and
+# double-count a window of increments into the tracer.
+_note_lock = threading.Lock()
 
 
 def note_integrity() -> dict:
-    """Fold native seal-counter deltas since the last call into the
-    tracer as ``integrity.sealed`` / ``integrity.verified`` /
+    """Fold native integrity-counter deltas since the last call into
+    the tracer as ``integrity.sealed`` / ``integrity.verified`` /
     ``integrity.failed`` / ``integrity.retransmitted`` — the recovery
     path and tests observe the whole detect→retransmit ladder in the
     same stream as ``world.rebuild``/``trainer.resume``. Returns the
-    deltas."""
-    now = seal_counters()
-    deltas = {}
-    for k, v in now.items():
-        d = v - _integrity_noted.get(k, 0)
-        if d > 0:
-            trace.add(f"integrity.{k}", d)
-        deltas[k] = max(d, 0)
-        _integrity_noted[k] = v
-    return deltas
+    deltas. Reads the unified native counter registry: one snapshot
+    call under one lock, so concurrent callers cannot double-count
+    (the poll-bridge race this replaced)."""
+    with _note_lock:
+        snap = native_counters()
+        deltas = {}
+        for k in _SEAL_COUNTER_NAMES:
+            v = snap[f"integrity.{k}"]
+            d = v - _integrity_noted.get(k, 0)
+            if d > 0:
+                trace.add(f"integrity.{k}", d)
+            deltas[k] = max(d, 0)
+            _integrity_noted[k] = v
+        return deltas
 
 
 def _check(cond, what: str):
@@ -550,6 +687,12 @@ class QueuePair:
         incarnation tag, NAK-driven chunk retransmit). Emu-only; the
         verbs wire carries its own ICRC."""
         return bool(_load().tdr_qp_has_seal(_live(self._h, "has_seal")))
+
+    @property
+    def telemetry_id(self) -> int:
+        """Flight-recorder track id of this QP (bring-up ordinal;
+        names the per-QP timeline in Perfetto exports)."""
+        return int(_load().tdr_tel_qp_id(_live(self._h, "telemetry_id")))
 
     def poll(self, max_wc: int = 16, timeout_ms: int = -1) -> List[Completion]:
         arr = (Wc * max_wc)()
@@ -749,6 +892,13 @@ class Engine:
     @property
     def name(self) -> str:
         return _load().tdr_engine_name(_live(self._h, "engine.name")).decode()
+
+    @property
+    def telemetry_id(self) -> int:
+        """Flight-recorder track id of this engine (open ordinal;
+        names the per-rank/engine timeline in Perfetto exports)."""
+        return int(_load().tdr_tel_engine_id(
+            _live(self._h, "telemetry_id")))
 
     def reg_mr(self, buf, access: int = ACCESS_REMOTE_WRITE | ACCESS_REMOTE_READ
                ) -> MemoryRegion:

@@ -42,7 +42,62 @@ if "xla_force_host_platform_device_count" not in flags:
 # via monkeypatch (tests/test_zero_copy.py).
 os.environ.setdefault("TDR_RING_TIMEOUT_MS", "120000")
 
+import glob  # noqa: E402
+import subprocess  # noqa: E402
+
 import pytest  # noqa: E402
+
+# ------------------------------------------------------------------
+# Native staleness guard: rebuild libtdr.so (and the sanitize variant,
+# when present) whenever any native source/header is newer than the
+# checked artifact. The Python loader (transport/engine.py) only
+# builds when the .so is MISSING, so without this an ABI change —
+# telemetry event structs, counter registry layout — would silently
+# run the suite against a stale library and fail (or worse, pass) for
+# the wrong reasons.
+_NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "rocnrdma_tpu", "native")
+
+
+def _native_sources():
+    return (glob.glob(os.path.join(_NATIVE, "src", "*.cc"))
+            + glob.glob(os.path.join(_NATIVE, "src", "*.h"))
+            + glob.glob(os.path.join(_NATIVE, "include", "tdr", "*.h"))
+            + [os.path.join(_NATIVE, "Makefile")])
+
+
+def _stale(artifact: str) -> bool:
+    art_mtime = os.path.getmtime(artifact)
+    return any(os.path.getmtime(src) > art_mtime
+               for src in _native_sources())
+
+
+def _make(target=None) -> None:
+    cmd = ["make", "-s", "-C", _NATIVE, "TUNE=native"]
+    if target:
+        cmd.append(target)
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        # Surface the compiler diagnostic — an opaque CalledProcessError
+        # at collection time would hide what failed to build.
+        raise RuntimeError(
+            f"native rebuild failed ({' '.join(cmd)}):\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-4000:]}")
+
+
+def _ensure_fresh_native() -> None:
+    so = os.path.join(_NATIVE, "libtdr.so")
+    if not os.path.exists(so) or _stale(so):
+        _make()
+    san = os.path.join(_NATIVE, "libtdr_san.so")
+    # The sanitize artifact is built on demand by the slow tier; only
+    # keep it fresh if it already exists (building ASan objects on
+    # every tier-1 run would be pure tax).
+    if os.path.exists(san) and _stale(san):
+        _make("sanitize")
+
+
+_ensure_fresh_native()
 
 
 def pytest_configure(config):

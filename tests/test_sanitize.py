@@ -43,6 +43,7 @@ def _libasan_path():
 _SAN_SCRIPT = """
 import socket, threading
 import numpy as np
+from rocnrdma_tpu import telemetry
 from rocnrdma_tpu.collectives.world import local_worlds
 s = socket.socket(); s.bind(("127.0.0.1", 0))
 port = s.getsockname()[1]; s.close()
@@ -54,6 +55,13 @@ ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
 [t.start() for t in ts]; [t.join() for t in ts]
 for b in bufs:
     np.testing.assert_array_equal(b, np.full(65536, 3.0, np.float32))
+# Telemetry ran under ASan+UBSan too (TDR_TELEMETRY=1 in the env):
+# the recorder must have captured the run, and drain + export must be
+# clean under the sanitizer as well.
+assert telemetry.enabled(), "telemetry must be on under the sanitizer"
+events = telemetry.timeline()
+assert any(e.name == "wc" for e in events), "no native events recorded"
+telemetry.export_trace("/dev/null", events=events)
 for w in worlds:
     w.close()
 print("SAN_WORLD2_OK")
@@ -83,6 +91,9 @@ def test_sanitized_sealed_world2_allreduce():
         "TDR_NATIVE_LIB": os.path.join(NATIVE, "libtdr_san.so"),
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         "JAX_PLATFORMS": "cpu",
+        # Run the flight recorder's event paths under the sanitizer
+        # too — every emit/drain/histogram touch gets swept.
+        "TDR_TELEMETRY": "1",
     })
     run = subprocess.run([sys.executable, "-c", _SAN_SCRIPT],
                          capture_output=True, text=True, timeout=300,

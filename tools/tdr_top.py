@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""tdr_top — live CLI view of the flight recorder.
+
+Renders the unified counter registry, the log2 latency/bandwidth
+histograms (as sparklines with p50/p90/p99), and event-ring health,
+refreshing in place like top(1).
+
+Two ways to attach:
+
+  **--file SNAP.json** — watch a snapshot file a workload writes via
+  ``telemetry.start_snapshot_writer(path)`` (the cross-process mode:
+  counters live in the workload's process, so they reach this tool as
+  periodic snapshots, not shared memory).
+
+  **--demo** — run a world-2 emu allreduce loop IN this process with
+  telemetry on and watch it live (the zero-setup showcase).
+
+  ``--once`` prints a single frame and exits (scripting / tests).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_SPARK = " .:-=+*#%@"
+
+
+def sparkline(buckets, width=32) -> str:
+    """Compress 64 log2 buckets into a width-char intensity strip
+    (linear in log count — tails stay visible next to huge modes)."""
+    import math
+
+    if not any(buckets):
+        return "-" * width
+    per = max(1, (len(buckets) + width - 1) // width)
+    cells = [sum(buckets[i:i + per]) for i in range(0, len(buckets), per)]
+    peak = math.log1p(max(cells))
+    out = []
+    for c in cells[:width]:
+        lvl = int(math.log1p(c) / peak * (len(_SPARK) - 1)) if peak else 0
+        out.append(_SPARK[lvl])
+    return "".join(out)
+
+
+def render(snap: dict) -> str:
+    lines = []
+    lines.append("tdr_top — flight recorder  "
+                 f"[recording={'ON' if snap.get('enabled') else 'off'} "
+                 f"recorded={snap.get('recorded', 0)} "
+                 f"dropped={snap.get('dropped', 0)}]")
+    lines.append("")
+    lines.append("histograms (log2 buckets; p50/p90/p99 upper-edge):")
+    pct = snap.get("percentiles", {})
+    for name, buckets in sorted(snap.get("histograms", {}).items()):
+        p = pct.get(name, {})
+        lines.append(f"  {name:<14} |{sparkline(buckets)}| "
+                     f"n={sum(buckets):<8} p50={p.get('p50', 0):<8} "
+                     f"p90={p.get('p90', 0):<8} p99={p.get('p99', 0)}")
+    lines.append("")
+    lines.append("counters:")
+    counters = snap.get("counters", {})
+    groups = {}
+    for name, val in sorted(counters.items()):
+        groups.setdefault(name.split(".")[0], []).append((name, val))
+    for _, items in sorted(groups.items()):
+        for name, val in items:
+            if val:
+                lines.append(f"  {name:<28} {val}")
+    return "\n".join(lines)
+
+
+def demo_traffic(stop: threading.Event) -> None:
+    """Background world-2 allreduce loop feeding the live view."""
+    import socket
+
+    import numpy as np
+
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    worlds = local_worlds(2, port)
+    bufs = [np.ones(1 << 18, dtype=np.float32) for _ in range(2)]
+    try:
+        while not stop.is_set():
+            ts = [threading.Thread(target=worlds[r].allreduce,
+                                   args=(bufs[r],)) for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            stop.wait(0.05)
+    finally:
+        for w in worlds:
+            w.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tdr_top", description=__doc__)
+    ap.add_argument("--file", default=None,
+                    help="snapshot file written by "
+                         "telemetry.start_snapshot_writer()")
+    ap.add_argument("--demo", action="store_true",
+                    help="drive an in-process world-2 allreduce loop")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    args = ap.parse_args(argv)
+
+    stop = threading.Event()
+    if args.demo:
+        from rocnrdma_tpu import telemetry
+
+        telemetry.enable()
+        t = threading.Thread(target=demo_traffic, args=(stop,), daemon=True)
+        t.start()
+
+    def frame() -> str:
+        if args.file:
+            try:
+                with open(args.file) as f:
+                    return render(json.load(f))
+            except FileNotFoundError:
+                return f"waiting for snapshot file {args.file} ..."
+            except json.JSONDecodeError:
+                return f"snapshot {args.file} mid-write, retrying ..."
+        from rocnrdma_tpu import telemetry
+
+        return render(telemetry.snapshot())
+
+    try:
+        if args.once:
+            if args.demo:
+                # Wait for the first recorded events, not a blind
+                # sleep — the traffic thread imports jax/numpy and
+                # bootstraps a world first, which can outlast any
+                # fixed delay on a loaded box.
+                from rocnrdma_tpu.transport.engine import \
+                    telemetry_recorded
+
+                deadline = time.monotonic() + 30
+                while (telemetry_recorded() == 0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+            print(frame())
+            return 0
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H" + frame() + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        stop.set()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
