@@ -108,13 +108,25 @@ def bench_p2p_write(size=1 << 30, iters=3):
     return size * iters / dt / 1e9
 
 
-def bench_allreduce(count=(1 << 30) // 4, world=2, iters=3):
-    """2-rank 1 GiB f32 ring allreduce bus bandwidth (config 3 shape)."""
+def bench_allreduce(count=(1 << 30) // 4, world=2, iters=3, channels=None):
+    """2-rank 1 GiB f32 ring allreduce bus bandwidth (config 3 shape).
+    ``channels`` overrides TDR_RING_CHANNELS for this run (the channel
+    sweep drives it; None = ambient default)."""
     from rocnrdma_tpu.collectives.world import local_worlds
 
     port = _free_port()
 
-    worlds = local_worlds(world, port + 1000)
+    prev = os.environ.get("TDR_RING_CHANNELS")
+    if channels is not None:
+        os.environ["TDR_RING_CHANNELS"] = str(channels)
+    try:
+        worlds = local_worlds(world, port + 1000)
+    finally:
+        if channels is not None:
+            if prev is None:
+                os.environ.pop("TDR_RING_CHANNELS", None)
+            else:
+                os.environ["TDR_RING_CHANNELS"] = prev
     bufs = [np.ones(count, dtype=np.float32) for _ in range(world)]
     # Front-load MR registration (the reference's invariant): the timed
     # loop must post work requests only.
@@ -140,6 +152,67 @@ def bench_allreduce(count=(1 << 30) // 4, world=2, iters=3):
     # Standard bus-bandwidth convention: 2*(world-1)/world of the
     # buffer crosses each rank's link per allreduce.
     return nbytes * 2 * (world - 1) / world / dt / 1e9
+
+
+def bench_channel_sweep(count, world=4, iters=2):
+    """Multi-channel ring sweep: world-`world` allreduce bus bandwidth
+    for TDR_RING_CHANNELS in {1, 2, 4, 8}, with the fold-offload
+    pool's occupancy (busy-time / wall) alongside. On an in-process
+    emu ring every channel is another progress thread, so the sweep
+    shows where this HOST's core count stops rewarding parallelism —
+    the knee is machine-truth the tuning section points at, not a
+    universal constant."""
+    from rocnrdma_tpu.transport.engine import (fold_pool_workers,
+                                               native_counters)
+
+    out = {"fold_threads": fold_pool_workers()}
+    per = {}
+    for ch in (1, 2, 4, 8):
+        c0 = native_counters()
+        t0 = time.perf_counter()
+        bw = bench_allreduce(count=count, world=world, iters=iters,
+                             channels=ch)
+        wall = time.perf_counter() - t0
+        c1 = native_counters()
+        busy_us = c1["fold.busy_us"] - c0["fold.busy_us"]
+        per[str(ch)] = {
+            "bus_GBps": round(bw, 3),
+            "fold_jobs": int(c1["fold.jobs"] - c0["fold.jobs"]),
+            # Fold-offload occupancy: fraction of the sweep's wall
+            # time a fold worker was busy. 0 on engines that fold in
+            # the transport (emu reduce-on-receive) — the offload only
+            # engages on the windowed-scratch schedule.
+            "fold_offload_occupancy": round(busy_us / 1e6 / wall, 4),
+        }
+    out["channels"] = per
+    best = max(per.items(), key=lambda kv: kv[1]["bus_GBps"])
+    out["best_channels"] = int(best[0])
+    out["best_bus_GBps"] = best[1]["bus_GBps"]
+    # The emu transport folds on receive (occupancy stays 0 above);
+    # drive the windowed-scratch schedule once (TDR_NO_RECV_REDUCE)
+    # so the fold-offload pool's occupancy is a MEASURED number — this
+    # is the schedule the offload exists for (engines whose folds
+    # would otherwise run inline in the ring's poll loop).
+    prev_norr = os.environ.get("TDR_NO_RECV_REDUCE")
+    os.environ["TDR_NO_RECV_REDUCE"] = "1"
+    try:
+        c0 = native_counters()
+        t0 = time.perf_counter()
+        bw = bench_allreduce(count=count, world=2, iters=iters, channels=4)
+        wall = time.perf_counter() - t0
+        c1 = native_counters()
+        out["windowed_fold"] = {
+            "bus_GBps": round(bw, 3),
+            "fold_jobs": int(c1["fold.jobs"] - c0["fold.jobs"]),
+            "fold_offload_occupancy": round(
+                (c1["fold.busy_us"] - c0["fold.busy_us"]) / 1e6 / wall, 4),
+        }
+    finally:
+        if prev_norr is None:
+            os.environ.pop("TDR_NO_RECV_REDUCE", None)
+        else:
+            os.environ["TDR_NO_RECV_REDUCE"] = prev_norr
+    return out
 
 
 def bench_alltoall(count=(256 << 20) // 4, world=2, iters=3):
@@ -212,12 +285,17 @@ def bench_staged(nbytes=512 << 20, leaves=16, iters=3):
             w.close()
     finally:
       os.environ.pop("TDR_STAGE_PIPELINE", None)
-    # On this 1-vCPU host pipelined ≈ serial by construction: the
-    # D2H gather, ring, and H2D scatter are all CPU work sharing one
-    # core, so there is nothing to overlap WITH. The pipeline pays on
-    # hosts where staging copies ride a DMA engine / second core.
-    out["staged_note"] = ("pipelined==serial expected on 1-vCPU hosts; "
-                          "overlap needs a second engine")
+    # The interleaving is real (tests/test_staged_pipeline.py asserts
+    # via the flight recorder that gather k+1 starts while ring k is
+    # on the wire), but the RATIO only rewards it when the ring phase
+    # has idle CPU to hide copies under. With `world` in-process ranks
+    # saturating this host's cores, both modes run at total-work /
+    # cores and pipelined ≈ serial BY CONSTRUCTION; the pipeline pays
+    # where the staging copies ride a DMA engine (real device hosts)
+    # or cores exceed the rank count.
+    out["staged_note"] = ("pipelined==serial expected while ranks "
+                          "saturate this host's cores; overlap needs "
+                          "idle cycles (DMA staging or cores > ranks)")
     return out
 
 
@@ -285,6 +363,30 @@ def write_bench_record(details, bus, tel, quick, details_path):
             "allreduce_world4_bus": details.get("allreduce_world4_bus_GBps"),
             "staged_pipelined": details.get("staged_pipelined_GBps"),
             "staged_serial": details.get("staged_serial_GBps"),
+        },
+        # Multi-channel sweep: per-channel-count bus bandwidth and
+        # fold-offload occupancy for the world-4 ring (the tentpole's
+        # TDR_RING_CHANNELS knob), plus which count the headline used.
+        "allreduce_world4_vs_bound": details.get("allreduce_world4_vs_bound"),
+        "allreduce_world4_channels": details.get(
+            "allreduce_world4_channels"),
+        "allreduce_world4_by_channels": {
+            ch: v.get("bus_GBps")
+            for ch, v in details.get("allreduce_channel_sweep",
+                                     {}).get("channels", {}).items()
+        },
+        "fold_offload": {
+            "threads": details.get("allreduce_channel_sweep",
+                                   {}).get("fold_threads"),
+            "occupancy_by_channels": {
+                ch: v.get("fold_offload_occupancy")
+                for ch, v in details.get("allreduce_channel_sweep",
+                                         {}).get("channels", {}).items()
+            },
+            # The windowed-scratch run (TDR_NO_RECV_REDUCE): the
+            # schedule whose folds the offload pool actually carries.
+            "windowed": details.get("allreduce_channel_sweep",
+                                    {}).get("windowed_fold"),
         },
         # Log2-histogram upper-edge percentiles from the native flight
         # recorder (chunk = post→completion of individual transport
@@ -608,9 +710,16 @@ def main():
     # world>2 datapoint (wavefront schedule with last-RS-step
     # foldback): smaller buffer so four in-process ranks stay within
     # the CI box. Same bus-bandwidth convention and roofline context
-    # as the headline.
-    w4 = round(bench_allreduce(count=sizes["w4_count"], world=4, iters=2), 3)
+    # as the headline. Measured as a TDR_RING_CHANNELS sweep
+    # ({1,2,4,8} QPs per neighbor — quick mode included): the headline
+    # w4 number is the best channel count, recorded next to the whole
+    # sweep so the tuning knee on THIS host is visible, not implied.
+    sweep_ch = bench_channel_sweep(count=sizes["w4_count"], world=4,
+                                   iters=2)
+    details["allreduce_channel_sweep"] = sweep_ch
+    w4 = sweep_ch["best_bus_GBps"]
     details["allreduce_world4_bus_GBps"] = w4
+    details["allreduce_world4_channels"] = sweep_ch["best_channels"]
     details["allreduce_world4_bytes"] = sizes["w4_bytes"]
     # TRUE upper bound for world 4 on a 1-core host (VERDICT r04
     # weak-4/next-5: the previous two-charge "roofline" was beatable

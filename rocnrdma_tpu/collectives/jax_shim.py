@@ -65,7 +65,8 @@ from rocnrdma_tpu.collectives.world import RingWorld
 from rocnrdma_tpu.hbm.registry import (HbmError, MemoryExporter,
                                        RegistrationManager, as_ndarray)
 from rocnrdma_tpu.transport.engine import (ENGINE_VERBS, RED_SUM,
-                                           _NUMPY_DTYPE_MAP)
+                                           _NUMPY_DTYPE_MAP,
+                                           ring_chunk_bytes)
 from rocnrdma_tpu.utils.trace import trace
 
 # Bound on cached zero-copy registrations. XLA's allocator reuses
@@ -373,11 +374,36 @@ class CrossSliceAllReduce:
         # and retry-ladder-changing (budget): ranks that disagree must
         # fail the digest here, fast and explicably, never mis-parse
         # each other's frames or diverge on when to escalate.
+        # The chunk term hashes the EFFECTIVE chunk size, not the raw
+        # env string: two versions with TDR_RING_CHUNK unset but
+        # different built-in defaults split segments into different
+        # wire-chunk counts — that must fail the digest exchange, not
+        # wedge the ring mid-collective.
         sched = [f"world={self.world.world}",
-                 f"chunk={os.environ.get('TDR_RING_CHUNK', '')}",
+                 f"chunk={ring_chunk_bytes()}",
                  f"schunk={self._stage_chunk()}",
                  f"mean={int(self.mean)}", f"wfb={wfb}",
                  f"seal={getattr(self.world, 'seal_config', '')}"]
+        # Channel count is schedule-changing (chunk i rides channel
+        # i % channels — a rank striping differently posts to the
+        # wrong QPs): it joins the digest whenever it differs from the
+        # single-QP layout. channels == 1 deliberately contributes
+        # NOTHING, so a single-channel ring reproduces the legacy
+        # digest byte-for-byte (steady-state caches stay warm across
+        # the upgrade).
+        chan = int(getattr(self.world, "channels", 1) or 1)
+        if chan != 1:
+            sched.append(f"chan={chan}")
+        # Recv-reduce gating is schedule-selecting too (fused
+        # reduce-on-receive vs the windowed-scratch schedule), and it
+        # is a PER-PROCESS env knob (TDR_NO_RECV_REDUCE), never
+        # negotiated on the wire — a rank disagreeing would post a
+        # different wire sequence and wedge until the ring timeout.
+        # Like chan, the default (recv-reduce available) contributes
+        # nothing so legacy digests are preserved byte-for-byte.
+        left_qp = getattr(self.world, "left_qp", None)
+        if left_qp is not None and not left_qp.has_recv_reduce:
+            sched.append("norr=1")
         sched += [f"z:{nbytes}:{arr.dtype}" for _, nbytes, arr in coalesced]
         sched += [f"j:{nbytes}:{buf.dtype}" for _, nbytes, buf in jax_ops]
         # Per-leaf sizes (not just the sum): ranks with different
@@ -513,34 +539,55 @@ class CrossSliceAllReduce:
         if size:
             segs.append((start, size, members))
 
-        def gather(seg):
-            o = seg[0]
-            for i in seg[2]:
-                p = np.asarray(jax.device_get(leaves[i])).reshape(-1)
-                buf[o:o + p.size] = p
-                o += p.size
+        def gather(seg, k):
+            with trace.span("xslice.stage_gather", seg=k,
+                            rank=self.world.rank,
+                            bytes=seg[1] * itemsize):
+                o = seg[0]
+                for i in seg[2]:
+                    p = np.asarray(jax.device_get(leaves[i])).reshape(-1)
+                    buf[o:o + p.size] = p
+                    o += p.size
 
-        def scatter(seg):
-            o = seg[0]
-            flat = buf[seg[0]:seg[0] + seg[1]]
-            if self.mean:
-                if flat.dtype.kind in "iu":
-                    flat //= self.world.world
-                else:
-                    # Divide in the array's own dtype — no silent
-                    # downcast of f64 (or upcast of bf16) gradients.
-                    flat /= np.asarray(self.world.world, dtype=flat.dtype)
-            for i in seg[2]:
-                piece = buf[o:o + leaves[i].size]
-                o += leaves[i].size
-                piece = piece.reshape(np.shape(leaves[i])).copy()
-                if isinstance(leaves[i], np.ndarray):
-                    out[i] = piece
-                else:
-                    # Restore the leaf onto its original sharding so a
-                    # dp×tp mesh doesn't funnel gradients through one
-                    # device.
-                    out[i] = jax.device_put(piece, leaves[i].sharding)
+        def ring_op(seg, k):
+            with trace.span("xslice.stage_ring", seg=k,
+                            rank=self.world.rank,
+                            bytes=seg[1] * itemsize):
+                self.world.allreduce(buf[seg[0]:seg[0] + seg[1]], RED_SUM)
+
+        def scatter(seg, k):
+            with trace.span("xslice.stage_scatter", seg=k,
+                            rank=self.world.rank,
+                            bytes=seg[1] * itemsize):
+                o = seg[0]
+                for i in seg[2]:
+                    piece = buf[o:o + leaves[i].size]
+                    o += leaves[i].size
+                    # ONE pass into the fresh output leaf, the mean
+                    # folded into the same copy (np.multiply with out=)
+                    # — the old divide-in-place-then-.copy() touched
+                    # every byte twice.
+                    fresh = np.empty(np.shape(leaves[i]),
+                                     dtype=piece.dtype)
+                    flat = fresh.reshape(-1)
+                    if not self.mean:
+                        np.copyto(flat, piece)
+                    elif piece.dtype.kind in "iu":
+                        np.floor_divide(piece, self.world.world, out=flat)
+                    else:
+                        # Divide in the array's own dtype — no silent
+                        # downcast of f64 (or upcast of bf16) gradients.
+                        np.divide(piece,
+                                  np.asarray(self.world.world,
+                                             dtype=piece.dtype),
+                                  out=flat)
+                    if isinstance(leaves[i], np.ndarray):
+                        out[i] = fresh
+                    else:
+                        # Restore the leaf onto its original sharding
+                        # so a dp×tp mesh doesn't funnel gradients
+                        # through one device.
+                        out[i] = jax.device_put(fresh, leaves[i].sharding)
 
         # Opt-in since r05: measured against serial on the live chip,
         # the pipelined schedule ran at 0.41x (TPU_RESULTS_r05_staged
@@ -555,39 +602,50 @@ class CrossSliceAllReduce:
                      and os.environ.get("TDR_NO_STAGE_PIPELINE", "0")
                      in ("", "0"))
         if not pipelined:
-            for seg in segs:
-                gather(seg)
-                self.world.allreduce(buf[seg[0]:seg[0] + seg[1]], RED_SUM)
-                scatter(seg)
+            for k, seg in enumerate(segs):
+                gather(seg, k)
+                ring_op(seg, k)
+                scatter(seg, k)
             return
 
+        # Pipelined: ring ops run on a dedicated worker in segment
+        # order; THIS thread gathers segment k+1 (and scatters
+        # finished segments) while segment k is on the wire. The copy
+        # for the next chunk is issued the moment the previous chunk's
+        # ring op is SUBMITTED — not when it completes — which is the
+        # whole point; the stage_* spans above make the interleaving
+        # a checkable fact in the flight-recorder timeline (tests
+        # assert gather(k+1) starts before ring(k) ends).
         ex = self._stage_ex
         if ex is None:
             ex = self._stage_ex = ThreadPoolExecutor(
                 1, thread_name_prefix="tdr-stage")
         pending: deque = deque()
+        # Three in flight (gathering / on the wire / scattering): one
+        # deeper than strict double-buffering so per-rank skew in the
+        # collective's rendezvous is absorbed by the queue instead of
+        # stalling the gather side.
+        depth = 3
         try:
-            for seg in segs:
-                gather(seg)
-                fut = ex.submit(self.world.allreduce,
-                                buf[seg[0]:seg[0] + seg[1]], RED_SUM)
-                pending.append((fut, seg))
-                # Double-buffer: scatter the oldest segment once its
-                # reduction lands (keeping at most two in flight).
-                while len(pending) > 2 or (pending and
-                                           pending[0][0].done()):
-                    done_fut, done_seg = pending.popleft()
+            for k, seg in enumerate(segs):
+                gather(seg, k)
+                fut = ex.submit(ring_op, seg, k)
+                pending.append((fut, seg, k))
+                # Scatter the oldest segment once its reduction lands.
+                while len(pending) >= depth or (pending and
+                                                pending[0][0].done()):
+                    done_fut, done_seg, dk = pending.popleft()
                     done_fut.result()
-                    scatter(done_seg)
+                    scatter(done_seg, dk)
             while pending:
-                done_fut, done_seg = pending.popleft()
+                done_fut, done_seg, dk = pending.popleft()
                 done_fut.result()
-                scatter(done_seg)
+                scatter(done_seg, dk)
         except BaseException:
             # Drain the worker so no ring op runs concurrently with
             # the caller's error handling / teardown.
             while pending:
-                fut, _ = pending.popleft()
+                fut, _, _ = pending.popleft()
                 try:
                     fut.result()
                 except Exception:

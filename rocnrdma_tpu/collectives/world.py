@@ -40,6 +40,7 @@ from rocnrdma_tpu.transport.engine import (Engine, QueuePair, Ring, RED_SUM,
                                            TransportError,
                                            note_fault_injections,
                                            note_integrity,
+                                           ring_channels_default,
                                            seal_retry_budget)
 from rocnrdma_tpu.utils.trace import trace
 
@@ -69,6 +70,7 @@ class RingWorld:
         bind_host: str = "0.0.0.0",
         timeout_ms: int = 30000,
         generation: int = 0,
+        channels: Optional[int] = None,
     ):
         if world < 2:
             raise ValueError("RingWorld needs world >= 2")
@@ -79,11 +81,25 @@ class RingWorld:
         self.peers = list(peers) if peers else ["127.0.0.1"] * world
         self.bind_host = bind_host
         self.timeout_ms = timeout_ms
+        # Channels per neighbor (TDR_RING_CHANNELS, default 4): the
+        # striped schedules route chunk i over channel i % channels,
+        # so consecutive chunks transfer/verify/fold on independent
+        # progress engines. Channel c of my right neighbor link IS
+        # channel c of that rank's left link — guaranteed by bringing
+        # the connections up strictly in channel order below.
+        self.channels = int(channels) if channels is not None else \
+            ring_channels_default()
+        if self.channels < 1:
+            raise ValueError("channels must be >= 1")
         # Incarnation number of this ring; monotonic. The bootstrap
         # exchange adopts the ring maximum, so a restarted rank
         # (proposing its stale or zero count) catches up with the
         # survivors' rebuild() bumps.
         self.generation = int(generation)
+        # Per-channel neighbor QPs; left_qp/right_qp alias channel 0
+        # (the digest exchange and capability probes ride channel 0).
+        self.left_qps: List[QueuePair] = []
+        self.right_qps: List[QueuePair] = []
         self.left_qp: Optional[QueuePair] = None
         self.right_qp: Optional[QueuePair] = None
         self.ring: Optional[Ring] = None
@@ -123,41 +139,53 @@ class RingWorld:
         # (connections are incarnation-scoped), so the fence loses
         # nothing during the window.
         self.engine.clear_seal_context()
-        accepted: List[Optional[QueuePair]] = [None]
+        nchan = self.channels
+        accepted: List[Optional[QueuePair]] = [None] * nchan
         err: List[Optional[BaseException]] = [None]
 
         def _accept():
+            # Channels are accepted strictly in order on ONE port: the
+            # dialer's connect for channel c returns only after the
+            # full QP handshake — which requires this accept — so its
+            # dial for channel c+1 can never race into channel c's
+            # listener backlog. Connection order IS channel identity.
             try:
-                accepted[0] = self.engine.listen(
-                    "127.0.0.1"
-                    if self.peers[rank] in ("127.0.0.1", "localhost")
-                    else self.bind_host,
-                    self.base_port + rank, timeout_ms)
+                host = ("127.0.0.1"
+                        if self.peers[rank] in ("127.0.0.1", "localhost")
+                        else self.bind_host)
+                for c in range(nchan):
+                    accepted[c] = self.engine.listen(
+                        host, self.base_port + rank, timeout_ms)
             except BaseException as e:  # surfaced after join
                 err[0] = e
 
         t = threading.Thread(target=_accept, daemon=True)
         t.start()
+        dialed: List[QueuePair] = []
         try:
-            self.right_qp = self.engine.connect(
-                self.peers[right], self.base_port + right, timeout_ms)
+            for c in range(nchan):
+                dialed.append(self.engine.connect(
+                    self.peers[right], self.base_port + right, timeout_ms))
         except BaseException:
             # The accept side is deadline-bounded; reap whatever it
             # produced so the port is free for the next attempt.
-            t.join(timeout_ms / 1000 + 5)
-            if accepted[0] is not None:
-                accepted[0].close()
+            t.join(nchan * (timeout_ms / 1000 + 5))
+            for qp in dialed + [q for q in accepted if q is not None]:
+                qp.close()
             raise
-        t.join(timeout_ms / 1000 + 5)
-        if err[0] is not None or accepted[0] is None:
-            self.right_qp.close()
-            self.right_qp = None
+        t.join(nchan * (timeout_ms / 1000 + 5))
+        if err[0] is not None or any(q is None for q in accepted):
+            for qp in dialed + [q for q in accepted if q is not None]:
+                qp.close()
             if err[0] is not None:
                 raise err[0]
             raise TimeoutError("left neighbor never connected")
-        self.left_qp = accepted[0]
+        self.left_qps = [q for q in accepted if q is not None]
+        self.right_qps = dialed
+        self.left_qp = self.left_qps[0]
+        self.right_qp = self.right_qps[0]
         try:
-            self.ring = Ring(self.engine, self.left_qp, self.right_qp,
+            self.ring = Ring(self.engine, self.left_qps, self.right_qps,
                              rank, world)
             self._sched_verified = b""
             self._barrier_buf = None
@@ -177,10 +205,16 @@ class RingWorld:
             self._teardown()
             raise
         # tel_engine ties this rank to its native flight-recorder
-        # track, so exporters label the engine timeline "rank N".
+        # track, so exporters label the engine timeline "rank N";
+        # tel_left/tel_right name the per-channel QP lanes (chunk
+        # events for channel c carry these qp track ids, which is how
+        # tdr_top / Perfetto key per-channel histograms and lanes).
         trace.event("world.up", rank=rank, world=world,
                     generation=self.generation,
-                    tel_engine=self.engine.telemetry_id)
+                    tel_engine=self.engine.telemetry_id,
+                    channels=self.channels,
+                    tel_left=[qp.telemetry_id for qp in self.left_qps],
+                    tel_right=[qp.telemetry_id for qp in self.right_qps])
 
     def _ensure_digest_bufs(self) -> None:
         if self._dg_smr is not None:
@@ -418,10 +452,12 @@ class RingWorld:
         neighbor unblocks promptly instead of riding out the stall
         deadline."""
         ring, self.ring = self.ring, None
-        left, self.left_qp = self.left_qp, None
-        right, self.right_qp = self.right_qp, None
-        for closer in (ring and ring.destroy, left and left.close,
-                       right and right.close):
+        lefts, self.left_qps = self.left_qps, []
+        rights, self.right_qps = self.right_qps, []
+        self.left_qp = self.right_qp = None
+        closers = [ring and ring.destroy]
+        closers += [qp.close for qp in lefts + rights]
+        for closer in closers:
             if closer is None:
                 continue
             try:

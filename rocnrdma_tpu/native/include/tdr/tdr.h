@@ -84,6 +84,12 @@ const char *tdr_last_error(void);
  * (the emulated NIC's DMA-engine array; TDR_COPY_THREADS overrides). */
 size_t tdr_copy_pool_workers(void);
 
+/* Workers in the fold-offload pool (TDR_FOLD_THREADS): the threads
+ * that run the ring's scratch-window folds off the poll loop. 0 means
+ * folds run inline on the polling thread (1-core hosts, or the knob
+ * set to 0). */
+size_t tdr_fold_pool_workers(void);
+
 /* Cumulative bytes moved via the streaming (non-temporal) vs cached
  * (memcpy) copy tiers since process start — which path carried the
  * traffic (bench/diagnostics). */
@@ -161,6 +167,14 @@ void tdr_seal_context(tdr_engine *e, uint64_t gen_plus1, uint64_t step);
  * verbs backend relies on the wire's ICRC and advertises 0). */
 int tdr_qp_has_seal(tdr_qp *qp);
 
+/* Whether the negotiated seal's CRC covers the PAYLOAD bytes. True on
+ * the TCP stream tier; on the CMA tier (same-host kernel-memcpy
+ * "wire" — no payload bit-flip failure mode, the ICRC rationale) the
+ * default is tag-only sealing (generation fence + chunk seq +
+ * steering fields stay covered) and this returns 0 unless BOTH ends
+ * set TDR_SEAL_CMA=1 (FEAT_SEAL_CMA_FULL). */
+int tdr_qp_has_seal_payload(tdr_qp *qp);
+
 /* ------------------------------------------------------------------ *
  * Flight recorder — the engine-side telemetry subsystem.
  *
@@ -207,6 +221,11 @@ enum {
   TDR_TEL_COPY_RUN = 15,   /* copy-pool job finished: arg=duration us */
   TDR_TEL_RING_BEGIN = 16, /* collective entry: id=call seq, arg=bytes*/
   TDR_TEL_RING_END = 17,   /* collective exit: arg=0 ok / 1 failed    */
+  TDR_TEL_FOLD_OFF = 18,   /* scratch fold handed to the fold pool:
+                              id=chunk index, arg=bytes (the matching
+                              FOLD event fires when the worker runs
+                              it — the gap between the two is queue
+                              wait, fold-pool pressure made visible) */
 };
 
 /* Histograms (tdr_tel_hist_read). Log2 buckets: bucket b (1..63)
@@ -379,6 +398,29 @@ enum { TDR_RED_SUM = 0, TDR_RED_MAX = 1, TDR_RED_MIN = 2 };
  * The ring borrows the QPs; it does not close them. */
 tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
                           int rank, int world);
+/* Multi-channel ring: `channels` independent QPs per neighbor, chunk
+ * i of every striped schedule riding channel i % channels — the wire
+ * transfer, seal verification, and fold of consecutive chunks proceed
+ * in parallel on independent progress engines. lefts[c] on this rank
+ * must be connected to rights[c] on the left neighbor (the Python
+ * bootstrap brings channels up in index order, which guarantees it).
+ * Every channel must have negotiated identical capabilities
+ * (reduce-on-receive, foldback, fused2, seal) — creation fails
+ * otherwise, because a schedule striped across capability-divergent
+ * channels would desynchronize mid-collective. Completion ordering,
+ * verify-before-fold, NAK/retransmit budgets, and generation fencing
+ * all hold PER CHANNEL (each channel is its own QP: seal state and
+ * retransmit bookkeeping are channel-local by construction).
+ * channels == 1 is exactly tdr_ring_create. */
+tdr_ring *tdr_ring_create_channels(tdr_engine *e, tdr_qp *const *lefts,
+                                   tdr_qp *const *rights, int channels,
+                                   int rank, int world);
+/* Channel count of a ring (1 for tdr_ring_create rings). */
+int tdr_ring_channels(const tdr_ring *r);
+/* EFFECTIVE ring chunk size in bytes (TDR_RING_CHUNK override or the
+ * built-in default): the value schedule digests must hash — the raw
+ * env string hides a changed built-in default from the digest. */
+size_t tdr_ring_chunk_bytes(void);
 int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                        int red_op);
 /* The rest of the MPI-app collective surface, sharing the

@@ -16,6 +16,8 @@ const char *tdr_last_error(void) { return tdr::get_error(); }
 
 size_t tdr_copy_pool_workers(void) { return tdr::copy_pool_workers(); }
 
+size_t tdr_fold_pool_workers(void) { return tdr::fold_pool_workers(); }
+
 void tdr_copy_counters(uint64_t *nt_bytes, uint64_t *plain_bytes) {
   tdr::copy_counters(nt_bytes, plain_bytes);
 }
@@ -57,6 +59,10 @@ void tdr_seal_context(tdr_engine *e, uint64_t gen_plus1, uint64_t step) {
 
 int tdr_qp_has_seal(tdr_qp *qp) {
   return reinterpret_cast<Qp *>(qp)->has_seal() ? 1 : 0;
+}
+
+int tdr_qp_has_seal_payload(tdr_qp *qp) {
+  return reinterpret_cast<Qp *>(qp)->has_seal_payload() ? 1 : 0;
 }
 
 tdr_engine *tdr_engine_open(const char *spec) {

@@ -7,6 +7,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <functional>
 #include <string>
 
 #include "tdr/tdr.h"
@@ -118,6 +119,11 @@ class Qp {
   // verbs wire has ICRC; host-side sealing there would double-touch
   // every byte for protection the link already provides.
   virtual bool has_seal() const { return false; }
+  // Whether the negotiated seal's CRC covers the PAYLOAD bytes: true
+  // on the stream tier, false on the CMA tier unless both ends
+  // advertised FEAT_SEAL_CMA_FULL (the tag/steering fields are always
+  // covered on sealed connections).
+  virtual bool has_seal_payload() const { return has_seal(); }
   virtual int poll(tdr_wc *wc, int max, int timeout_ms) = 0;
   virtual int close_qp() = 0;
 };
@@ -165,6 +171,16 @@ enum : uint32_t {
   // the unsealed parser would misread as the next header, so it MUST
   // be negotiated (TDR_NO_SEAL acts at the advertising stage).
   FEAT_SEAL = 1u << 2,
+  // FULL payload CRC on the CMA tier. By default a sealed CMA-tier
+  // connection seals the TAG ONLY (generation fence, chunk seq, and
+  // the landing-steering header fields stay CRC-covered; the payload
+  // does not): the "wire" there is a kernel memcpy with no bit-flip
+  // failure mode a payload CRC could catch — the ICRC rationale the
+  // verbs backend already applies (has_seal=0). TDR_SEAL_CMA=1
+  // advertises this bit; both ends must set it (it changes what the
+  // trailer CRC covers, so a unilateral switch would fail every
+  // verification). The TCP stream tier always seals the payload.
+  FEAT_SEAL_CMA_FULL = 1u << 3,
 };
 
 // Locally-willing feature set (TDR_NO_FOLDBACK / TDR_NO_FUSED2 act
@@ -249,6 +265,19 @@ void reduce2_any(void *dst, void *src, size_t n, int dt, int op);
 // the serial path on 1-core machines or short lengths; parallel
 // reductions are bit-exact with serial ones (element-disjoint slices).
 size_t copy_pool_workers();
+// Fold-offload pool (copy_pool.cc): dedicated workers that run the
+// ring layer's scratch-window folds OFF the poll loop, so a chunk can
+// land while its predecessor folds (TDR_FOLD_THREADS; 0 and 1-core
+// hosts run folds inline — fold_pool_workers() returns 0 and
+// fold_submit executes the job on the calling thread). Jobs are
+// opaque closures; ordering between jobs is the CALLER's problem
+// (the ring gates slot reuse on per-chunk completion flags).
+size_t fold_pool_workers();
+void fold_submit(std::function<void()> fn);
+// Registry counters: jobs executed and cumulative busy time — the
+// bench derives fold-offload occupancy (busy/wall) from these.
+uint64_t fold_jobs();
+uint64_t fold_busy_us();
 // Cumulative bytes moved via the streaming (non-temporal) vs cached
 // (memcpy) copy tiers — bench/diagnostic visibility into which path
 // carried the traffic.

@@ -23,6 +23,7 @@
 #include <condition_variable>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <functional>
 #include <mutex>
 #include <thread>
@@ -38,19 +39,27 @@ namespace {
 // small enough for dynamic balance across NUMA-variable memcpy speeds.
 constexpr size_t kGrain = 4u << 20;
 
+// Usable cores: the affinity mask (the container/cgroup truth) first,
+// hardware_concurrency as the fallback, 1 when both are dark. Shared
+// by every pool in this file — only the env override and clamp policy
+// differ per pool.
+size_t detect_cores() {
+  cpu_set_t set;
+  if (sched_getaffinity(0, sizeof(set), &set) == 0) {
+    int n = CPU_COUNT(&set);
+    if (n >= 1) return static_cast<size_t>(n);
+  }
+  unsigned hc = std::thread::hardware_concurrency();
+  return hc ? hc : 1;
+}
+
 size_t pool_threads() {
   const char *env = getenv("TDR_COPY_THREADS");
   if (env && *env) {
     long v = atol(env);
     if (v >= 1) return static_cast<size_t>(std::min(v, 64L));
   }
-  cpu_set_t set;
-  if (sched_getaffinity(0, sizeof(set), &set) == 0) {
-    int n = CPU_COUNT(&set);
-    if (n >= 1) return static_cast<size_t>(std::min(n, 16));
-  }
-  unsigned hc = std::thread::hardware_concurrency();
-  return hc ? std::min(hc, 16u) : 1;
+  return std::min(detect_cores(), static_cast<size_t>(16));
 }
 
 }  // namespace
@@ -277,6 +286,111 @@ class CopyPool {
 };
 
 size_t copy_pool_workers() { return CopyPool::instance().workers(); }
+
+// ------------------------------------------------------------------
+// Fold-offload pool: the ring layer's scratch-window folds, off the
+// poll loop. Distinct from CopyPool on purpose: CopyPool::parfor is a
+// BLOCKING fork-join (the caller participates and waits), which is
+// exactly what the poll loop must stop doing — here jobs are
+// fire-and-forget closures whose completion the ring tracks itself
+// (per-chunk flags gating scratch-slot reuse). TDR_FOLD_THREADS
+// overrides the worker count; 0 — and any 1-core host — degrades to
+// inline execution on the calling thread, zero extra threads.
+// ------------------------------------------------------------------
+
+namespace {
+
+size_t fold_threads() {
+  const char *env = getenv("TDR_FOLD_THREADS");
+  if (env && *env) {
+    long v = atol(env);
+    if (v >= 0) return static_cast<size_t>(std::min(v, 16L));
+  }
+  size_t n = detect_cores();
+  // A 1-core host gains nothing from an offload thread (pure context-
+  // switch tax); otherwise a small pool — the folds are memory-bound,
+  // more workers than memory channels just thrash.
+  return n <= 1 ? 0 : std::min(n, static_cast<size_t>(4));
+}
+
+std::atomic<uint64_t> g_fold_jobs{0};
+std::atomic<uint64_t> g_fold_busy_us{0};
+
+class FoldPool {
+ public:
+  static FoldPool &instance() {
+    // Leaked for the same reason as CopyPool: jobs may still be
+    // draining at static-destruction time.
+    static FoldPool *p = new FoldPool(fold_threads());
+    return *p;
+  }
+
+  size_t workers() const { return nthreads_; }
+
+  void submit(std::function<void()> fn) {
+    if (nthreads_ == 0) {
+      run_one(fn);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      q_.push_back(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  static void run_one(const std::function<void()> &fn) {
+    // Busy time is tracked unconditionally (one clock pair per
+    // MB-scale fold — noise): the bench reads occupancy with the
+    // flight recorder off, where a telemetry-gated clock would read 0.
+    uint64_t t0 = tel_now_ns();
+    fn();
+    g_fold_jobs.fetch_add(1, std::memory_order_relaxed);
+    g_fold_busy_us.fetch_add((tel_now_ns() - t0) / 1000,
+                             std::memory_order_relaxed);
+  }
+
+  explicit FoldPool(size_t nthreads) : nthreads_(nthreads) {
+    for (size_t i = 0; i < nthreads_; i++)
+      threads_.emplace_back([this] { worker(); });
+  }
+
+  void worker() {
+    for (;;) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return !q_.empty(); });
+        fn = std::move(q_.front());
+        q_.pop_front();
+      }
+      run_one(fn);
+    }
+  }
+
+  const size_t nthreads_;
+  std::vector<std::thread> threads_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> q_;
+};
+
+}  // namespace
+
+size_t fold_pool_workers() { return FoldPool::instance().workers(); }
+
+void fold_submit(std::function<void()> fn) {
+  FoldPool::instance().submit(std::move(fn));
+}
+
+uint64_t fold_jobs() {
+  return g_fold_jobs.load(std::memory_order_relaxed);
+}
+
+uint64_t fold_busy_us() {
+  return g_fold_busy_us.load(std::memory_order_relaxed);
+}
 
 void par_memcpy(void *dst, const void *src, size_t len) {
   if (tel_on()) tel_hist_add(TDR_HIST_COPY_BYTES, len);

@@ -766,9 +766,19 @@ class EmuQp : public Qp {
     return queue_recv({wr_id, dst, maxlen, true, dtype, red_op, emr});
   }
 
-  bool has_recv_reduce() const override { return true; }
+  // Local (receiver-side) capability, not negotiated: a plain SEND
+  // matches either recv flavor, so disabling it only changes OUR
+  // posted-recv type. TDR_NO_RECV_REDUCE forces the ring onto the
+  // windowed-scratch schedule — the fold-offload path's test/bench
+  // hook (set it on ALL ranks, like TDR_NO_WAVEFRONT: schedule
+  // selection keys off it).
+  bool has_recv_reduce() const override {
+    return !env_set("TDR_NO_RECV_REDUCE");
+  }
 
   bool has_seal() const override { return seal_; }
+
+  bool has_seal_payload() const override { return seal_payload_; }
 
   int poll(tdr_wc *wc, int max, int timeout_ms) override {
     std::unique_lock<std::mutex> lk(mu_);
@@ -859,7 +869,10 @@ class EmuQp : public Qp {
         complete_recv(r,
                       deliver_buffer_wc(r, u.payload.data(),
                                         u.payload.size()));
-      } else if (seal_) {
+      } else if (seal_payload_) {
+        // Full sealing stages foldback payloads (verify-before-fold);
+        // tag-only connections resolved the tag at arrival and fold
+        // one-pass like unsealed ones.
         finish_foldback_sealed(r, u);
       } else {
         finish_foldback(r, u);
@@ -1132,6 +1145,8 @@ class EmuQp : public Qp {
     // a mismatched pair degrades to plain frames, never misparses).
     seal_ = (features_ & FEAT_SEAL) != 0;
     seal_budget_ = seal_retry_budget();
+    // seal_payload_ is resolved AFTER the CMA probe below: whether the
+    // trailer CRC covers the payload depends on the negotiated tier.
 
     // Same process is decided by the random token, never by pid (pids
     // are namespace-relative). An unreadable boot_id fails CLOSED:
@@ -1159,6 +1174,23 @@ class EmuQp : public Qp {
       return;
     }
     cma_ = my_ok && peer_res.cma_ok;
+    // CMA tier: tag-only sealing by default. The "wire" there is a
+    // kernel memcpy (process_vm_readv / same-process memcpy) with no
+    // payload bit-flip failure mode a CRC could catch — the same
+    // rationale under which the verbs backend advertises has_seal=0
+    // (the link's ICRC already covers the bytes). The trailer still
+    // travels and is still verified: the generation fence, chunk seq,
+    // and landing-steering fields (len/raddr) stay CRC-covered, so
+    // stale-incarnation ghosts and misdirected frames fail exactly as
+    // before — only the per-byte payload CRC (and with it the forced
+    // stage→verify→fold staging copy) is dropped, restoring the
+    // one-pass fused kernels on the hot path. FEAT_SEAL_CMA_FULL
+    // (TDR_SEAL_CMA=1, both ends) reinstates full payload sealing —
+    // the integrity tests drive the whole detect→NAK→retransmit
+    // ladder through it. Both sides compute this identically (cma_
+    // and features_ are agreed), so the CRC coverage never skews.
+    seal_payload_ =
+        seal_ && (!cma_ || (features_ & FEAT_SEAL_CMA_FULL) != 0);
   }
 
   // Caller already holds an ACTIVE inflight ref on `mr`
@@ -1224,7 +1256,11 @@ class EmuQp : public Qp {
     t.gen = static_cast<uint32_t>(eng_->seal_gen());
     t.step = static_cast<uint32_t>(eng_->seal_step());
     t.cseq = static_cast<uint32_t>(h.seq);
-    t.crc = seal_crc(t, h, src, len);
+    // Tag-only mode (CMA tier default): the CRC covers the tag and
+    // the steering fields, not the payload — both ends agreed on the
+    // coverage at handshake time, so verification stays symmetric.
+    t.crc = seal_payload_ ? seal_crc(t, h, src, len)
+                          : seal_crc(t, h, nullptr, 0);
     seal_count(kSealSealed);
     long long nb = fault_corrupt(
         "send", static_cast<long long>(wr_id & 0xffffffffffffull));
@@ -1807,6 +1843,308 @@ class EmuQp : public Qp {
     return send_frame(ack, nullptr, 0);
   }
 
+  // Verify a tag-only trailer (CMA tier default): CRC over the tag +
+  // steering fields, the cseq echo, and the incarnation fence — no
+  // payload bytes needed, so this runs BEFORE any data movement or
+  // recv consumption. Returns false on connection loss.
+  bool read_and_verify_tag(const FrameHdr &h, bool *ok_out) {
+    SealTrailer t{};
+    if (!read_full(fd_, &t, sizeof(t))) return false;
+    bool ok = seal_crc(t, h, nullptr, 0) == t.crc &&
+              t.cseq == static_cast<uint32_t>(h.seq);
+    uint64_t local = eng_->seal_gen();
+    if (ok && t.gen != 0 && local != 0 &&
+        t.gen != static_cast<uint32_t>(local))
+      ok = false;
+    seal_count(ok ? kSealVerified : kSealFailed);
+    tel(ok ? TDR_TEL_VERIFY_OK : TDR_TEL_VERIFY_FAIL, h.seq, h.len);
+    *ok_out = ok;
+    return true;
+  }
+
+  // Tag-only sealed SEND-class arrival (CMA tier): verify the trailer
+  // FIRST — it needs no payload bytes — then run the clean frame down
+  // the UNSEALED one-pass data path (fused folds straight off peer
+  // memory, in-place landings, bare acks): verify-before-fold holds
+  // with zero staging. A failed tag NAKs for a bounded retransmit
+  // without consuming a recv; FIFO pairing across the failure uses
+  // the same parked-recv / placeholder machinery as full sealing (a
+  // later clean message must not steal the failed frame's recv).
+  bool handle_tagonly_inbound(const FrameHdr &h, bool fb) {
+    if (h.len > kMaxUnexpectedBytes) return false;
+    const bool retx = h.status == 1;
+    bool verified = false;
+    if (!read_and_verify_tag(h, &verified)) return false;
+
+    if (verified) {
+      PostedRecv r{};
+      bool have_parked = false, placeholder = false;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        retx_attempts_.erase(h.seq);
+        if (retx) {
+          auto it = parked_.find(h.seq);
+          if (it != parked_.end()) {
+            r = it->second;
+            parked_.erase(it);
+            have_parked = true;
+          } else {
+            for (auto &u : unexpected_)
+              if (u.awaiting_retx && u.seq == h.seq) {
+                placeholder = true;
+                break;
+              }
+            if (!placeholder) return true;  // given up / flushed: drop
+          }
+        }
+      }
+      if (have_parked) {
+        // Deliver into the recv parked for this seq (its FIFO claim).
+        if (fb) {
+          Unexpected u;
+          u.fb = true;
+          u.desc = true;
+          u.seq = h.seq;
+          u.src_va = h.aux;
+          u.len = h.len;
+          bool sent = finish_foldback(r, u);
+          release_recv(r);
+          return sent;
+        }
+        FrameHdr ack{};
+        ack.op = OP_SEND_ACK;
+        ack.seq = h.seq;
+        tdr_wc wc;
+        bool moved = land_cma_wc(r, h.aux, h.len, &wc);
+        ack.status = moved ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
+        bool sent = send_frame(ack, nullptr, 0);
+        complete_recv(r, wc);
+        release_recv(r);
+        return sent;
+      }
+      if (placeholder) {
+        // Placeholder held the failed frame's FIFO slot: materialize
+        // the clean payload into it now. The poll thread may convert
+        // a front placeholder into a parked_ recv at any moment
+        // (queue_recv pop_front()s it, invalidating deque pointers),
+        // so the placeholder is re-resolved BY SEQ under one lock —
+        // never through a pointer cached across an unlock.
+        if (fb) {
+          PostedRecv pr{};
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            Unexpected *u = nullptr;
+            for (auto &cand : unexpected_)
+              if (cand.awaiting_retx && cand.seq == h.seq) {
+                u = &cand;
+                break;
+              }
+            if (u) {
+              // Foldback acks at fold time: the placeholder just
+              // becomes a normal pending foldback.
+              u->fb = true;
+              u->desc = true;
+              u->src_va = h.aux;
+              u->len = h.len;
+              u->awaiting_retx = false;
+              return true;
+            }
+            auto it = parked_.find(h.seq);
+            if (it == parked_.end()) return true;  // flushed: drop
+            pr = it->second;
+            parked_.erase(it);
+          }
+          Unexpected u;
+          u.fb = true;
+          u.desc = true;
+          u.seq = h.seq;
+          u.src_va = h.aux;
+          u.len = h.len;
+          bool sent = finish_foldback(pr, u);
+          release_recv(pr);
+          return sent;
+        }
+        // Plain send: the copy needs only the frame descriptor, so it
+        // runs unlocked; the destination (placeholder, or the recv it
+        // was parked into meanwhile) is resolved after, in one scope.
+        std::vector<char> buf(h.len);
+        bool moved = h.len == 0 ||
+                     par_cma_copy_from(peer_pid_, buf.data(), h.aux, h.len);
+        FrameHdr ack{};
+        ack.op = OP_SEND_ACK;
+        ack.seq = h.seq;
+        ack.status = moved ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
+        PostedRecv pr{};
+        bool now_parked = false, resolved = false;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          for (auto it = unexpected_.begin(); it != unexpected_.end();
+               ++it)
+            if (it->awaiting_retx && it->seq == h.seq) {
+              if (moved) {
+                it->payload = std::move(buf);
+                it->len = h.len;
+                it->fb = false;
+                it->awaiting_retx = false;
+              } else {
+                // CMA failure: the placeholder is dead (sender
+                // completes with the error ack, no retransmit).
+                unexpected_.erase(it);
+              }
+              resolved = true;
+              break;
+            }
+          if (!resolved) {
+            auto it = parked_.find(h.seq);
+            if (it != parked_.end()) {
+              pr = it->second;
+              parked_.erase(it);
+              now_parked = true;
+            }
+          }
+        }
+        if (now_parked) {
+          if (moved) {
+            complete_recv(pr, deliver_buffer_wc(pr, buf.data(),
+                                                buf.size()));
+          } else {
+            complete_recv(pr,
+                          {pr.wr_id, TDR_WC_GENERAL_ERR, TDR_OP_RECV,
+                           h.len});
+          }
+          release_recv(pr);
+        } else if (!resolved) {
+          return true;  // flushed while copying: drop, no ack
+        }
+        return send_frame(ack, nullptr, 0);
+      }
+      // Fresh clean frame: exactly the unsealed data path.
+      return fb ? handle_foldback_inbound(h, /*desc=*/true)
+                : handle_send_inbound(h, /*desc=*/true);
+    }
+
+    // Tag corrupt or stale incarnation: NAK within the budget. The
+    // frame consumed nothing, but its recv claim must survive the
+    // retry — park the FIFO-front recv (fresh failure) or leave the
+    // placeholder standing (repeat failure).
+    FrameHdr ack{};
+    ack.op = fb ? OP_SEND_FB_ACK : OP_SEND_ACK;
+    ack.seq = h.seq;
+    PostedRecv r{};
+    bool have = false, was_parked = false, send_nak = false;
+    int att = 0;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      Unexpected *ph = nullptr;
+      if (retx) {
+        auto it = parked_.find(h.seq);
+        if (it != parked_.end()) {
+          r = it->second;
+          have = true;
+          was_parked = true;
+        } else {
+          for (auto &u : unexpected_)
+            if (u.awaiting_retx && u.seq == h.seq) {
+              ph = &u;
+              break;
+            }
+          if (!ph) return true;  // already given up: drop
+        }
+      } else if (!recvs_.empty()) {
+        r = recvs_.front();
+        recvs_.pop_front();
+        have = true;
+      }
+      att = ++retx_attempts_[h.seq];
+      if (att <= seal_budget_) {
+        send_nak = true;
+        if (have && !was_parked) parked_[h.seq] = r;
+        if (!have && !ph) {
+          Unexpected u;
+          u.fb = fb;
+          u.desc = true;
+          u.seq = h.seq;
+          u.src_va = h.aux;
+          u.len = h.len;
+          u.awaiting_retx = true;
+          unexpected_.push_back(std::move(u));
+        }
+      } else {
+        retx_attempts_.erase(h.seq);
+        if (was_parked) parked_.erase(h.seq);
+        if (ph) {
+          for (auto it = unexpected_.begin(); it != unexpected_.end(); ++it)
+            if (it->awaiting_retx && it->seq == h.seq) {
+              unexpected_.erase(it);
+              break;
+            }
+        }
+      }
+    }
+    if (send_nak) {
+      tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
+      FrameHdr nak{};
+      nak.op = OP_NAK;
+      nak.seq = h.seq;
+      return send_frame(nak, nullptr, 0);
+    }
+    ack.status = TDR_WC_INTEGRITY_ERR;
+    bool sent = send_frame(ack, nullptr, 0);
+    if (have) {
+      complete_recv(r,
+                    {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len});
+      release_recv(r);
+    }
+    return sent;
+  }
+
+  // Tag-only sealed WRITE (CMA tier): verify the trailer, then the
+  // unsealed desc-write body. No recv FIFO involved — a failed tag
+  // just NAKs for retransmit from the pending source.
+  bool handle_tagonly_write(const FrameHdr &h) {
+    bool verified = false;
+    if (!read_and_verify_tag(h, &verified)) return false;
+    if (!verified) {
+      int att;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        att = ++retx_attempts_[h.seq];
+        if (att > seal_budget_) retx_attempts_.erase(h.seq);
+      }
+      if (att <= seal_budget_) {
+        tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
+        FrameHdr nak{};
+        nak.op = OP_NAK;
+        nak.seq = h.seq;
+        return send_frame(nak, nullptr, 0);
+      }
+      FrameHdr ack{};
+      ack.op = OP_WRITE_ACK;
+      ack.seq = h.seq;
+      ack.status = TDR_WC_INTEGRITY_ERR;
+      return send_frame(ack, nullptr, 0);
+    }
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      retx_attempts_.erase(h.seq);
+    }
+    EmuMr *tmr = nullptr;
+    char *dst = eng_->resolve(h.rkey, h.raddr, h.len,
+                              TDR_ACCESS_REMOTE_WRITE, &tmr);
+    FrameHdr ack{};
+    ack.op = OP_WRITE_ACK;
+    ack.seq = h.seq;
+    if (dst) {
+      tel(TDR_TEL_LAND, h.seq, h.len);
+      bool ok = par_cma_copy_from(peer_pid_, dst, h.aux, h.len);
+      EmuEngine::dma_done(tmr);
+      ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
+    } else {
+      ack.status = TDR_WC_REM_ACCESS_ERR;
+    }
+    return send_frame(ack, nullptr, 0);
+  }
+
   // Drain len payload bytes we cannot place (bad rkey etc.).
   bool drain(uint64_t len) {
     char scratch[65536];
@@ -1894,7 +2232,11 @@ class EmuQp : public Qp {
           // CMA tier; peer_pid_ is meaningless otherwise.
           if (!cma_) goto out;
           if (seal_) {
-            if (!handle_sealed_write(h, /*desc=*/true)) goto out;
+            if (seal_payload_) {
+              if (!handle_sealed_write(h, /*desc=*/true)) goto out;
+            } else {
+              if (!handle_tagonly_write(h)) goto out;
+            }
             break;
           }
           EmuMr *tmr = nullptr;
@@ -1939,8 +2281,12 @@ class EmuQp : public Qp {
         case OP_SEND_DESC: {
           if (!cma_) goto out;
           if (seal_) {
-            if (!handle_sealed_inbound(h, /*desc=*/true, /*fb=*/false))
-              goto out;
+            if (seal_payload_) {
+              if (!handle_sealed_inbound(h, /*desc=*/true, /*fb=*/false))
+                goto out;
+            } else {
+              if (!handle_tagonly_inbound(h, /*fb=*/false)) goto out;
+            }
             break;
           }
           if (!handle_send_inbound(h, /*desc=*/true)) goto out;
@@ -1958,8 +2304,12 @@ class EmuQp : public Qp {
         case OP_SEND_FB_DESC: {
           if (!cma_) goto out;
           if (seal_) {
-            if (!handle_sealed_inbound(h, /*desc=*/true, /*fb=*/true))
-              goto out;
+            if (seal_payload_) {
+              if (!handle_sealed_inbound(h, /*desc=*/true, /*fb=*/true))
+                goto out;
+            } else {
+              if (!handle_tagonly_inbound(h, /*fb=*/true)) goto out;
+            }
             break;
           }
           if (!handle_foldback_inbound(h, /*desc=*/true)) goto out;
@@ -2145,8 +2495,12 @@ class EmuQp : public Qp {
   uint64_t probe_val_ = 0;
   uint32_t features_ = 0;
   // Sealed framing (FEAT_SEAL negotiated) and the per-chunk
-  // retransmit budget, both fixed at handshake time.
+  // retransmit budget, both fixed at handshake time. seal_payload_:
+  // whether the trailer CRC covers the payload bytes (always on the
+  // stream tier; CMA tier only under FEAT_SEAL_CMA_FULL — see
+  // handshake()).
   bool seal_ = false;
+  bool seal_payload_ = false;
   int seal_budget_ = 3;
 
   std::mutex send_mu_;  // serializes frame submission on the socket

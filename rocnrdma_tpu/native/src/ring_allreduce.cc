@@ -7,13 +7,31 @@
 // pair and cached, preserving the reference's front-loaded-registration
 // invariant: steady-state steps post work requests only.
 //
-// Large segments are split into chunks (TDR_RING_CHUNK, default 8 MiB)
+// Large segments are split into chunks (TDR_RING_CHUNK, default 4 MiB)
 // with a small window of pre-posted receives, so the wire transfer of
 // chunk i+1 overlaps the reduction of chunk i and the link never idles
 // behind the ALU.
+//
+// Multi-channel striping (tdr_ring_create_channels): the ring may hold
+// TDR_RING_CHANNELS independent QPs per neighbor; every striped
+// schedule routes chunk i over channel i % channels, so the wire
+// transfer, seal verification, and fold of CONSECUTIVE chunks run on
+// independent progress engines instead of serializing on one QP's
+// thread. FIFO recv matching holds per channel (both sides stripe by
+// the same index rule, and channel c here is connected to channel c
+// on the neighbor by bootstrap construction); cross-channel completion
+// order is arbitrary, so the schedules track per-stream done-masks and
+// use the in-order completed PREFIX wherever a dependency needs
+// "everything before me landed". Scratch-window folds are handed to
+// the fold-offload pool (TDR_FOLD_THREADS, copy_pool.cc) so the poll
+// loop keeps posting while predecessors fold; the scratch window is
+// sized at two slots per channel — a chunk can land while its
+// predecessor on the same channel is still folding.
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -28,7 +46,12 @@
 
 namespace {
 
-constexpr size_t kDefaultChunk = 8u << 20;
+// 4 MiB (was 8): striping needs at least `channels` chunks per ring
+// segment to engage, and the finer grain pipelines land/fold/verify
+// better on every schedule — measured on the bench host: world-2
+// +25% median, world-4 best-median config (chunks below ~1 MiB start
+// paying per-frame overhead instead). TDR_RING_CHUNK still overrides.
+constexpr size_t kDefaultChunk = 4u << 20;
 constexpr int kWindow = 4;  // pre-posted recv slots per step
 // Cap on work requests in flight per direction, below the verbs
 // backend's QP depth (max_send_wr/max_recv_wr = 512) with headroom —
@@ -130,8 +153,14 @@ struct RingTelScope {
 
 struct tdr_ring {
   tdr_engine *eng;
+  // Channel 0 aliases: the chain collectives (reduce/broadcast/
+  // alltoall — inherently order-dependent store-and-forward pipelines)
+  // and the digest-era callers run on channel 0; the striped
+  // schedules use the full vectors.
   tdr_qp *left;   // receive from
   tdr_qp *right;  // send to
+  std::vector<tdr_qp *> lefts, rights;  // lefts[c] pairs with the
+                                        // neighbor's rights[c]
   int rank;
   int world;
   size_t chunk = kDefaultChunk;
@@ -188,21 +217,63 @@ RingTelScope::RingTelScope(tdr_ring *r, uint64_t bytes) {
 
 extern "C" {
 
-tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
-                          int rank, int world) {
-  if (!e || !left || !right || world < 2 || rank < 0 || rank >= world) {
+tdr_ring *tdr_ring_create_channels(tdr_engine *e, tdr_qp *const *lefts,
+                                   tdr_qp *const *rights, int channels,
+                                   int rank, int world) {
+  if (!e || !lefts || !rights || channels < 1 || world < 2 || rank < 0 ||
+      rank >= world) {
     tdr::set_error("ring_create: bad topology");
     return nullptr;
   }
+  for (int c = 0; c < channels; c++) {
+    if (!lefts[c] || !rights[c]) {
+      tdr::set_error("ring_create: null channel QP");
+      return nullptr;
+    }
+  }
+  // Capability skew across channels would desynchronize a striped
+  // schedule mid-collective (chunk i fused, chunk i+1 not): all
+  // channels to one neighbor must have negotiated identical features.
+  // Same peer + same env makes this true in practice; a half-failed
+  // handshake is caught here instead of as a wedged collective.
+  for (int c = 1; c < channels; c++) {
+    if (tdr_qp_has_recv_reduce(lefts[c]) !=
+            tdr_qp_has_recv_reduce(lefts[0]) ||
+        tdr_qp_has_send_foldback(rights[c]) !=
+            tdr_qp_has_send_foldback(rights[0]) ||
+        tdr_qp_has_send_foldback(lefts[c]) !=
+            tdr_qp_has_send_foldback(lefts[0]) ||
+        tdr_qp_has_fused2(lefts[c]) != tdr_qp_has_fused2(lefts[0]) ||
+        tdr_qp_has_fused2(rights[c]) != tdr_qp_has_fused2(rights[0]) ||
+        tdr_qp_has_seal(lefts[c]) != tdr_qp_has_seal(lefts[0]) ||
+        tdr_qp_has_seal(rights[c]) != tdr_qp_has_seal(rights[0])) {
+      tdr::set_error("ring_create: channel " + std::to_string(c) +
+                     " negotiated different capabilities than channel 0");
+      return nullptr;
+    }
+  }
   auto *r = new tdr_ring();
   r->eng = e;
-  r->left = left;
-  r->right = right;
+  r->lefts.assign(lefts, lefts + channels);
+  r->rights.assign(rights, rights + channels);
+  r->left = r->lefts[0];
+  r->right = r->rights[0];
   r->rank = rank;
   r->world = world;
   r->chunk = ring_chunk_bytes();
   return r;
 }
+
+tdr_ring *tdr_ring_create(tdr_engine *e, tdr_qp *left, tdr_qp *right,
+                          int rank, int world) {
+  return tdr_ring_create_channels(e, &left, &right, 1, rank, world);
+}
+
+int tdr_ring_channels(const tdr_ring *r) {
+  return r ? static_cast<int>(r->lefts.size()) : 0;
+}
+
+size_t tdr_ring_chunk_bytes(void) { return ring_chunk_bytes(); }
 
 void tdr_ring_destroy(tdr_ring *r) {
   if (!r) return;
@@ -284,156 +355,409 @@ int tdr_ring_adopt_mr(tdr_ring *r, void *base, tdr_mr *mr) {
   return 0;
 }
 
+// The schedule structs and helpers below are C++ (templates) inside a
+// file whose API surface is extern "C": reopen C++ linkage for them.
+extern "C++" {
 namespace {
 
+// ------------------------------------------------------------------
+// Multi-channel completion plumbing shared by the striped schedules.
+// A schedule exposes `int on_wc(bool left_side, size_t chan, const
+// tdr_wc &wc)` plus `void owed_channel(bool*, size_t*)`; sweep_side()
+// drains every channel of one side without blocking, and wait_owed()
+// parks a bounded slice on the channel owed the oldest outstanding
+// completion so blocking happens where the critical path advances and
+// a stall on any channel still honors the ring deadline.
+// ------------------------------------------------------------------
+
+template <typename S>
+int sweep_side(const std::vector<tdr_qp *> &qps, S &sched, bool left) {
+  tdr_wc wc[16];
+  int total = 0;
+  for (size_t c = 0; c < qps.size(); c++) {
+    for (;;) {
+      int n = tdr_poll(qps[c], wc, 16, 0);
+      if (n < 0) return -1;
+      for (int i = 0; i < n; i++)
+        if (sched.on_wc(left, c, wc[i]) != 0) return -1;
+      total += n;
+      if (n < 16) break;
+    }
+  }
+  return total;
+}
+
+// Block up to slice_ms on the channel the schedule says is OWED the
+// oldest outstanding completion (sched.owed_channel — per-channel
+// FIFO makes "oldest outstanding recv" a exact channel choice, so the
+// blocking poll parks where the critical-path completion will arrive,
+// not on an arbitrary channel while work queues elsewhere).
+// Deadlock-free regardless of the choice: every owed completion
+// eventually arrives on its own channel, and the caller re-sweeps all
+// channels after each wake; a wrong guess costs at most slice_ms.
+template <typename S>
+int wait_owed(tdr_ring *r, S &sched, int slice_ms) {
+  bool left = true;
+  size_t chan = 0;
+  sched.owed_channel(&left, &chan);
+  tdr_qp *qp = (left ? r->lefts : r->rights)[chan];
+  tdr_wc wc[16];
+  int n = tdr_poll(qp, wc, 16, slice_ms);
+  if (n < 0) return -1;
+  for (int i = 0; i < n; i++)
+    if (sched.on_wc(left, chan, wc[i]) != 0) return -1;
+  return n;
+}
+
+// Channel holding the oldest outstanding item of one striped stream:
+// per-channel FIFO means channel c's next completion is index
+// c + done[c]*nc, so the argmin over channels with posted > done IS
+// the stream's oldest outstanding chunk. SIZE_MAX when none.
+inline size_t oldest_outstanding(const std::vector<size_t> &posted,
+                                 const std::vector<size_t> &done,
+                                 size_t nc, size_t *chan) {
+  size_t best = static_cast<size_t>(-1);
+  for (size_t c = 0; c < nc; c++) {
+    if (posted[c] <= done[c]) continue;
+    size_t idx = c + done[c] * nc;
+    if (idx < best) {
+      best = idx;
+      *chan = c;
+    }
+  }
+  return best;
+}
+
 struct StepPipe {
-  tdr_ring *r;
-  tdr_mr *dmr;
-  char *cdata;
-  int dtype, red_op;
-  size_t esz;
+  tdr_ring *r = nullptr;
+  tdr_mr *dmr = nullptr;
+  char *cdata = nullptr;
+  int dtype = 0, red_op = 0;
+  size_t esz = 0;
+
+  StepPipe(tdr_ring *ring, tdr_mr *mr, char *data, int dt, int op,
+           size_t elem)
+      : r(ring), dmr(mr), cdata(data), dtype(dt), red_op(op), esz(elem) {}
+
+  // ---- per-run state (reset at the top of run()) ----
+  size_t chunk = 0, nc = 1;
+  size_t send_off_ = 0, send_len_ = 0, recv_off_ = 0, recv_len_ = 0;
+  size_t n_send = 0, n_recv = 0;
+  bool fused = false, windowed = false;
+  size_t slots = 0, slot_bytes = 0;
+  size_t posted_r = 0, done_r = 0, posted_s = 0, acked_s = 0;
+  std::vector<size_t> posted_rc, done_rc, posted_sc, acked_sc;
+  std::vector<size_t> rwin_c, swin_c;  // per-channel window budgets
+  bool bad = false;  // an on_wc error was recorded
+
+  // Async fold tracking (windowed mode). fold_done gates scratch-slot
+  // reuse: recv for chunk i may repost only once chunk i-slots has
+  // FOLDED (not merely landed) — the slot is its fold's source.
+  bool offload = false;
+  uint16_t eng_tel = 0;
+  std::mutex fmu;
+  std::condition_variable fcv;
+  std::vector<uint8_t> fold_done;
+  size_t folds_out = 0;  // submitted to the pool, not yet finished
+  size_t folded = 0;     // chunks whose fold completed (any path)
+
+  size_t chunk_len(size_t total, size_t i) const {
+    return std::min(chunk, total - i * chunk);
+  }
+
+  void fold_chunk(size_t idx) {
+    size_t len = chunk_len(recv_len_, idx);
+    // Single-threaded on the fold worker: parallelism comes from
+    // channels × workers, not from forking each fold (which would
+    // serialize jobs on the copy pool's one-region lock).
+    tdr::reduce_any(cdata + recv_off_ + idx * chunk,
+                    r->tmp.data() + (idx % slots) * slot_bytes, len / esz,
+                    dtype, red_op);
+    TDR_TEL(TDR_TEL_FOLD, eng_tel, 0, idx, len);
+    std::lock_guard<std::mutex> g(fmu);
+    fold_done[idx] = 1;
+    folded++;
+    folds_out--;
+    fcv.notify_all();
+  }
+
+  bool fold_ready(size_t i) {
+    if (!windowed || i < slots) return true;
+    std::lock_guard<std::mutex> g(fmu);
+    return fold_done[i - slots] != 0;
+  }
+
+  int post_recv_chunk(size_t i) {
+    size_t len = chunk_len(recv_len_, i);
+    size_t c = i % nc;
+    tdr_qp *qp = r->lefts[c];
+    int rc;
+    if (fused)
+      rc = tdr_post_recv_reduce(qp, dmr, recv_off_ + i * chunk, len, dtype,
+                                red_op, kWrRecv | i);
+    else if (windowed)
+      rc = tdr_post_recv(qp, r->scratch(slots * slot_bytes),
+                         (i % slots) * slot_bytes, len, kWrRecv | i);
+    else
+      rc = tdr_post_recv(qp, dmr, recv_off_ + i * chunk, len, kWrRecv | i);
+    if (rc == 0) posted_rc[c]++;
+    return rc;
+  }
+
+  // Where the oldest outstanding completion will arrive: the recv
+  // stream first (it is the critical path — folds and the peer's send
+  // window both key off landed chunks), else any channel owing a send
+  // ack.
+  void owed_channel(bool *left, size_t *chan) {
+    size_t c = 0;
+    if (oldest_outstanding(posted_rc, done_rc, nc, &c) !=
+        static_cast<size_t>(-1)) {
+      *left = true;
+      *chan = c;
+      return;
+    }
+    for (size_t i = 0; i < nc; i++) {
+      if (posted_sc[i] > acked_sc[i]) {
+        *left = false;
+        *chan = i;
+        return;
+      }
+    }
+    *left = true;
+    *chan = 0;
+  }
+
+  int on_wc(bool left, size_t chan, const tdr_wc &wc) {
+    (void)left;
+    if (wc.status != TDR_WC_SUCCESS) {
+      tdr::set_error("ring: completion error status " +
+                     wc_status_label(wc.status));
+      bad = true;
+      return -1;
+    }
+    uint64_t kind = wc.wr_id & kWrKindMask;
+    size_t idx = wc.wr_id & ~kWrKindMask;
+    if (kind == kWrSend) {
+      acked_s++;
+      acked_sc[idx % nc]++;
+    } else if (kind == kWrRecv) {
+      // Per-channel FIFO: channel c carries chunks c, c+nc, c+2nc, …
+      // in posted order; cross-channel arrival order is free.
+      if (idx != chan + done_rc[chan] * nc) {
+        tdr::set_error("ring: out-of-order recv completion on channel " +
+                       std::to_string(chan));
+        bad = true;
+        return -1;
+      }
+      done_rc[chan]++;
+      done_r++;
+      if (windowed) {
+        size_t len = chunk_len(recv_len_, idx);
+        if (offload) {
+          {
+            std::lock_guard<std::mutex> g(fmu);
+            folds_out++;
+          }
+          TDR_TEL(TDR_TEL_FOLD_OFF, eng_tel, 0, idx, len);
+          tdr::fold_submit([this, idx] { fold_chunk(idx); });
+        } else {
+          // Inline fallback (no fold workers): the legacy path, with
+          // the copy pool forking the fold itself.
+          tdr::par_reduce(cdata + recv_off_ + idx * chunk,
+                          r->tmp.data() + (idx % slots) * slot_bytes,
+                          len / esz, dtype, red_op);
+          std::lock_guard<std::mutex> g(fmu);
+          fold_done[idx] = 1;
+          folded++;
+        }
+      }
+    }
+    return 0;
+  }
 
   // One neighbor-exchange step: stream `send_len` bytes of the data
   // buffer at `send_off` rightward while receiving `recv_len` bytes
-  // from the left, chunked so transfer and reduction overlap.
+  // from the left, chunk i striped over channel i % channels.
   //
   // reduce=true → phase-1 semantics: inbound chunks are folded into
   // data at recv_off. On engines with reduce-on-receive the fold
   // happens in the transport's progress engine directly from the
   // inbound bytes (no scratch at all); otherwise chunks land in a
-  // windowed scratch and are folded here.
+  // double-buffered windowed scratch (two slots per channel) and fold
+  // on the fold-offload pool — the poll loop keeps posting while
+  // predecessors fold, and a chunk lands while the previous chunk on
+  // its channel is still folding.
   // reduce=false → phase-2 semantics: receives land directly in the
   // data MR at recv_off (no copy, no reduce).
   int run(size_t send_off, size_t send_len, size_t recv_off, size_t recv_len,
           bool reduce) {
-    const size_t chunk = r->chunk;
-    const size_t n_send = send_len ? (send_len + chunk - 1) / chunk : 0;
-    const size_t n_recv = recv_len ? (recv_len + chunk - 1) / chunk : 0;
-    const bool fused = reduce && tdr_qp_has_recv_reduce(r->left);
-    const bool windowed = reduce && !fused;
-    const size_t slots =
-        windowed ? (n_recv < static_cast<size_t>(kWindow)
-                        ? (n_recv ? n_recv : 1)
-                        : kWindow)
-                 : 0;
-    const size_t slot_bytes =
-        windowed ? std::min(chunk, recv_len ? recv_len : 1) : 0;
-    tdr_mr *tmr = nullptr;
-    if (windowed && n_recv) {
-      tmr = r->scratch(slots * slot_bytes);
-      if (!tmr) return -1;
+    chunk = r->chunk;
+    nc = r->lefts.size();
+    send_off_ = send_off;
+    send_len_ = send_len;
+    recv_off_ = recv_off;
+    recv_len_ = recv_len;
+    n_send = send_len ? (send_len + chunk - 1) / chunk : 0;
+    n_recv = recv_len ? (recv_len + chunk - 1) / chunk : 0;
+    fused = reduce && tdr_qp_has_recv_reduce(r->lefts[0]);
+    windowed = reduce && !fused;
+    // Double-buffered per channel (so landing i+nc overlaps folding i
+    // on every channel), never below the legacy window depth.
+    slots = windowed
+                ? std::min(n_recv ? n_recv : 1,
+                           std::max(static_cast<size_t>(kWindow), 2 * nc))
+                : 0;
+    slot_bytes = windowed ? std::min(chunk, recv_len ? recv_len : 1) : 0;
+    if (windowed && n_recv && !r->scratch(slots * slot_bytes)) return -1;
+
+    posted_r = done_r = posted_s = acked_s = 0;
+    posted_rc.assign(nc, 0);
+    done_rc.assign(nc, 0);
+    posted_sc.assign(nc, 0);
+    acked_sc.assign(nc, 0);
+    bad = false;
+    offload = windowed && tdr::fold_pool_workers() > 0;
+    eng_tel = reinterpret_cast<tdr::Engine *>(r->eng)->tel_id;
+    {
+      std::lock_guard<std::mutex> g(fmu);
+      fold_done.assign(windowed ? n_recv : 0, 0);
+      folds_out = 0;
+      folded = 0;
+    }
+    // Whatever happens below, never return while a fold job still
+    // references the scratch window or the data buffer.
+    struct FoldDrain {
+      StepPipe *p;
+      ~FoldDrain() {
+        std::unique_lock<std::mutex> lk(p->fmu);
+        p->fcv.wait(lk, [&] { return p->folds_out == 0; });
+      }
+    } fold_drain{this};
+    (void)fold_drain;
+
+    rwin_c.assign(nc, 0);
+    swin_c.assign(nc, 0);
+    for (size_t c = 0; c < nc; c++) {
+      rwin_c[c] = fused ? reduce_recv_window(r->lefts[c]) : kMaxOutstanding;
+      // In-flight send bound: the schedule is symmetric, so the peer's
+      // reduce-recv window (≈ ours, same config) caps how many phase-1
+      // sends can land — racing further ahead just RNR-NAK-storms a
+      // real HCA (the mock and emu absorb it, hiding the collapse).
+      swin_c[c] = reduce ? reduce_recv_window(r->rights[c])
+                         : kMaxOutstanding;
     }
 
-    auto chunk_len = [chunk](size_t total, size_t i) {
-      size_t start = i * chunk;
-      return std::min(chunk, total - start);
-    };
+    const bool same_qp = (r->lefts[0] == r->rights[0]);
 
-    size_t posted_r = 0, done_r = 0, posted_s = 0, acked_s = 0;
-
-    auto post_recv_chunk = [&](size_t i) -> int {
-      size_t len = chunk_len(recv_len, i);
-      if (fused)
-        return tdr_post_recv_reduce(r->left, dmr, recv_off + i * chunk, len,
-                                    dtype, red_op, kWrRecv | i);
-      if (windowed) {
-        size_t slot = i % slots;
-        return tdr_post_recv(r->left, tmr, slot * slot_bytes, len,
-                             kWrRecv | i);
+    // Post whatever the windows allow, strictly in global chunk order
+    // (which IS per-channel posted order — FIFO matching needs nothing
+    // more). Returns progress, or -1.
+    auto post_more = [&]() -> int {
+      bool progressed = false;
+      while (posted_r < n_recv) {
+        size_t c = posted_r % nc;
+        if (posted_rc[c] - done_rc[c] >= rwin_c[c]) break;
+        if (windowed && !fold_ready(posted_r)) break;
+        if (post_recv_chunk(posted_r) != 0) return -1;
+        posted_r++;
+        progressed = true;
       }
-      return tdr_post_recv(r->left, dmr, recv_off + i * chunk, len,
-                           kWrRecv | i);
-    };
-
-    // Receives without a slot dependency (phase 2, and fused phase 1 —
-    // disjoint folds straight into the data MR) are pre-posted deep so
-    // inbound chunks always have a landing target; windowed phase-1
-    // receives pre-post up to the scratch window. Both bounded by the
-    // QP depth — drain() reposts as completions retire.
-    size_t prepost = windowed
-                         ? std::min(n_recv, slots)
-                         : std::min(n_recv, fused ? reduce_recv_window(r->left)
-                                                  : kMaxOutstanding);
-    for (; posted_r < prepost; posted_r++)
-      if (post_recv_chunk(posted_r) != 0) return -1;
-
-    const bool same_qp = (r->left == r->right);
-    auto drain = [&](tdr_qp *qp, int timeout_ms) -> int {
-      tdr_wc wc[16];
-      int n = tdr_poll(qp, wc, 16, timeout_ms);
-      if (n < 0) return -1;
-      for (int i = 0; i < n; i++) {
-        if (wc[i].status != TDR_WC_SUCCESS) {
-          tdr::set_error("ring: completion error status " +
-                         wc_status_label(wc[i].status));
+      // Keep outbound traffic moving: in stream mode the post blocks
+      // while the chunk drains into the socket (the progress thread
+      // lands inbound chunks concurrently); in CMA mode it just
+      // queues a descriptor. The windowed throttle tracks LANDED
+      // chunks (the peer's symmetric scratch window), not folds.
+      while (posted_s < n_send) {
+        size_t c = posted_s % nc;
+        if (posted_sc[c] - acked_sc[c] >= swin_c[c]) break;
+        if (windowed && n_recv && posted_s >= done_r + slots) break;
+        size_t len = chunk_len(send_len_, posted_s);
+        if (tdr_post_send(r->rights[c], dmr, send_off_ + posted_s * chunk,
+                          len, kWrSend | posted_s) != 0)
           return -1;
-        }
-        uint64_t kind = wc[i].wr_id & kWrKindMask;
-        if (kind == kWrSend) {
-          acked_s++;
-        } else if (kind == kWrRecv) {
-          // TCP FIFO + FIFO recv queue ⇒ recv completions arrive in
-          // chunk order; fold and recycle the slot.
-          size_t idx = wc[i].wr_id & ~kWrKindMask;
-          if (idx != done_r) {
-            tdr::set_error("ring: out-of-order recv completion");
-            return -1;
-          }
-          if (windowed) {
-            size_t len = chunk_len(recv_len, idx);
-            tdr::par_reduce(cdata + recv_off + idx * chunk,
-                            r->tmp.data() + (idx % slots) * slot_bytes,
-                            len / esz, dtype, red_op);
-          }
-          done_r++;
-          if (posted_r < n_recv) {
-            if (post_recv_chunk(posted_r) != 0) return -1;
-            posted_r++;
-          }
-        }
-      }
-      return n;
-    };
-
-    // In-flight send bound: the schedule is symmetric, so the peer's
-    // reduce-recv window (≈ ours, same config) caps how many phase-1
-    // sends can land — racing further ahead just RNR-NAK-storms a
-    // real HCA (the mock and emu absorb it, hiding the collapse).
-    const size_t send_win =
-        reduce ? reduce_recv_window(r->right) : kMaxOutstanding;
-    while (done_r < n_recv || acked_s < n_send) {
-      // Keep outbound traffic moving: in stream mode this blocks while
-      // the chunk drains into the socket (the progress thread lands
-      // inbound chunks concurrently); in CMA mode it just queues a
-      // descriptor. In phase 1 stay within the peer's recv window —
-      // the schedule is symmetric, so our reduce progress tracks the
-      // peer's posted recvs; racing ahead would push inbound messages
-      // onto the unexpected (bounce-buffer) path and double-copy them.
-      bool may_send = posted_s < n_send &&
-                      posted_s - acked_s < send_win &&
-                      (!windowed || n_recv == 0 || posted_s < done_r + slots);
-      if (may_send) {
-        size_t len = chunk_len(send_len, posted_s);
-        if (tdr_post_send(r->right, dmr, send_off + posted_s * chunk, len,
-                          kWrSend | posted_s) != 0)
-          return -1;
+        posted_sc[c]++;
         posted_s++;
-        // Opportunistically reap without blocking so slots recycle.
-        if (drain(r->left, 0) < 0) return -1;
-        if (!same_qp && drain(r->right, 0) < 0) return -1;
+        progressed = true;
+      }
+      return progressed ? 1 : 0;
+    };
+
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(ring_timeout_ms());
+    size_t last_folded = 0;
+    for (;;) {
+      {
+        std::lock_guard<std::mutex> g(fmu);
+        if (done_r == n_recv && acked_s == n_send &&
+            (!windowed || folded == n_recv))
+          break;
+        if (folded != last_folded) {
+          last_folded = folded;
+          deadline = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(ring_timeout_ms());
+        }
+      }
+      int p = post_more();
+      if (p < 0) return -1;
+      int nl = sweep_side(r->lefts, *this, true);
+      if (nl < 0) return -1;
+      int nr = same_qp ? 0 : sweep_side(r->rights, *this, false);
+      if (nr < 0) return -1;
+      if (p > 0 || nl > 0 || nr > 0) {
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::milliseconds(ring_timeout_ms());
         continue;
       }
-      // All sends posted: block for what remains.
-      bool need_recv = done_r < n_recv;
-      tdr_qp *qp = need_recv ? r->left : r->right;
-      int n = drain(qp, ring_timeout_ms());
-      if (n < 0) return -1;
-      if (n == 0) {
-        tdr::set_error("ring: poll timeout");
-        return -1;
+      if (done_r == n_recv && acked_s == n_send) {
+        // Only folds left: they are pure local CPU work — wait on the
+        // fold cv, not the wire.
+        std::unique_lock<std::mutex> lk(fmu);
+        fcv.wait(lk, [&] { return folded == n_recv; });
+        continue;
       }
-      if (!same_qp && need_recv && acked_s < n_send) {
-        if (drain(r->right, 0) < 0) return -1;
+      // Wire idle but fold-gated (every posted recv landed, every
+      // send acked, posting blocked on scratch slots): the only
+      // possible progress is offloaded folds, and a fold completion
+      // signals fcv — a QP poll would just sleep its full slice.
+      if (windowed && posted_r == done_r && posted_s == acked_s) {
+        bool fold_moved;
+        {
+          std::unique_lock<std::mutex> lk(fmu);
+          fcv.wait_for(lk, std::chrono::milliseconds(50),
+                       [&] { return folded != last_folded; });
+          fold_moved = folded != last_folded;
+        }
+        if (!fold_moved && std::chrono::steady_clock::now() >= deadline) {
+          tdr::set_error("ring: fold stall (s " + std::to_string(acked_s) +
+                         "/" + std::to_string(n_send) + " r " +
+                         std::to_string(done_r) + "/" +
+                         std::to_string(n_recv) + ")");
+          return -1;
+        }
+        continue;
+      }
+      // Nothing postable, nothing completed: block a slice on the
+      // channel owed the oldest outstanding completion, so the wake
+      // happens where the critical path advances and a genuine stall
+      // still trips the ring deadline.
+      int n = wait_owed(r, *this, 50);
+      if (n < 0) return -1;
+      if (n > 0) {
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::milliseconds(ring_timeout_ms());
+        continue;
+      }
+      bool fold_moved;
+      {
+        std::lock_guard<std::mutex> g(fmu);
+        fold_moved = folded != last_folded;
+      }
+      if (!fold_moved && std::chrono::steady_clock::now() >= deadline) {
+        tdr::set_error("ring: poll timeout (s " + std::to_string(acked_s) +
+                       "/" + std::to_string(n_send) + " r " +
+                       std::to_string(done_r) + "/" +
+                       std::to_string(n_recv) + ")");
+        return -1;
       }
     }
     return 0;
@@ -445,6 +769,8 @@ struct StepPipe {
 namespace {
 
 // World-2 fused exchange: reduce-scatter and all-gather overlapped
+// (C++ linkage continues through these schedule structs; the linkage
+// block closes before the extern-C collective entry points.)
 // chunk-wise. The generic schedule runs the two phases back to back;
 // for world=2 they use OPPOSITE directions of the two neighbor QPs
 // (phase 1 rides right→peer-left, phase 2 rides left→peer-right), so
@@ -460,14 +786,14 @@ namespace {
 // progress engine as chunks arrive) and distinct left/right QPs; the
 // caller falls back to the generic two-phase pipeline otherwise.
 struct FusedTwo {
-  tdr_ring *r;
-  tdr_mr *dmr;
-  int dtype, red_op;
+  tdr_ring *r = nullptr;
+  tdr_mr *dmr = nullptr;
+  int dtype = 0, red_op = 0;
 
-  size_t chunk;
+  size_t chunk = 0;
   // A = the segment this rank sends out first and receives back
   // reduced; B = the segment it folds locally and returns.
-  size_t a_off, a_len, b_off, b_len;
+  size_t a_off = 0, a_len = 0, b_off = 0, b_len = 0;
   size_t n_a = 0, n_b = 0;
   // Foldback mode: A chunks go out as fold-and-write-back sends whose
   // acks mean "the reduced final landed in place" — the two return
@@ -475,10 +801,24 @@ struct FusedTwo {
   // the fold+return is one pass in the peer's progress engine.
   bool use_fb = false;
 
+  // Stream bookkeeping, striped chunk i → channel i % nc. Recv
+  // completions may arrive out of GLOBAL order across channels (per
+  // channel they stay FIFO — asserted via the per-channel counters),
+  // so both inbound streams keep done-masks; the B stream also keeps
+  // the in-order folded PREFIX (fr_rB) because returning reduced
+  // chunk k to the peer requires k's fold complete AND FIFO order on
+  // the left channel k % nc.
+  size_t nc = 1;
   size_t posted_rB = 0, done_rB = 0;   // left in: B chunks to fold
   size_t posted_sB = 0, acked_sB = 0;  // left out: reduced B chunks
   size_t posted_sA = 0, acked_sA = 0;  // right out: A chunks
   size_t posted_rA = 0, done_rA = 0;   // right in: reduced A chunks
+  std::vector<uint8_t> mask_rB, mask_rA;
+  size_t fr_rB = 0;  // in-order folded prefix of the B stream
+  std::vector<size_t> done_rBc, done_rAc;      // per-channel order check
+  std::vector<size_t> pc_rB, pc_rA, pc_sA, ac_sA;  // per-channel windows
+  std::vector<size_t> pc_sB, ac_sB;  // per-channel sB accounting
+  std::vector<size_t> rb_win, sa_win;
 
   static size_t nchunks(size_t len, size_t chunk) {
     return len ? (len + chunk - 1) / chunk : 0;
@@ -488,120 +828,200 @@ struct FusedTwo {
   }
 
   int post_recv_b(size_t i) {
-    return tdr_post_recv_reduce(r->left, dmr, b_off + i * chunk,
-                                clen(b_len, i), dtype, red_op, kWrRecv | i);
+    int rc = tdr_post_recv_reduce(r->lefts[i % nc], dmr, b_off + i * chunk,
+                                  clen(b_len, i), dtype, red_op,
+                                  kWrRecv | i);
+    if (rc == 0) pc_rB[i % nc]++;
+    return rc;
   }
   int post_recv_a(size_t i) {
-    return tdr_post_recv(r->right, dmr, a_off + i * chunk, clen(a_len, i),
-                         kWrRecv | i);
+    int rc = tdr_post_recv(r->rights[i % nc], dmr, a_off + i * chunk,
+                           clen(a_len, i), kWrRecv | i);
+    if (rc == 0) pc_rA[i % nc]++;
+    return rc;
   }
 
-  // Drain one QP's completions; `left` routes them to the B streams
-  // (fold + reduced-send acks), else to the A streams.
-  int drain(bool left, int timeout_ms) {
-    tdr_wc wc[16];
-    tdr_qp *qp = left ? r->left : r->right;
-    int n = tdr_poll(qp, wc, 16, timeout_ms);
-    if (n < 0) return -1;
-    for (int i = 0; i < n; i++) {
-      if (wc[i].status != TDR_WC_SUCCESS) {
-        tdr::set_error("ring(fused2): completion error status " +
-                       wc_status_label(wc[i].status));
-        return -1;
+  int on_wc(bool left, size_t chan, const tdr_wc &wc) {
+    if (wc.status != TDR_WC_SUCCESS) {
+      tdr::set_error("ring(fused2): completion error status " +
+                     wc_status_label(wc.status));
+      return -1;
+    }
+    uint64_t kind = wc.wr_id & kWrKindMask;
+    size_t idx = wc.wr_id & ~kWrKindMask;
+    if (kind == kWrSend) {
+      if (left) {
+        acked_sB++;
+        ac_sB[idx % nc]++;
+      } else {
+        acked_sA++;
+        ac_sA[idx % nc]++;
       }
-      uint64_t kind = wc[i].wr_id & kWrKindMask;
-      size_t idx = wc[i].wr_id & ~kWrKindMask;
-      if (kind == kWrSend) {
-        (left ? acked_sB : acked_sA)++;
-      } else if (kind == kWrRecv) {
-        size_t &done = left ? done_rB : done_rA;
-        if (idx != done) {
-          tdr::set_error("ring(fused2): out-of-order recv completion");
-          return -1;
-        }
-        done++;
-        size_t &posted = left ? posted_rB : posted_rA;
-        size_t total = left ? n_b : n_a;
-        if (posted < total) {
-          if ((left ? post_recv_b(posted) : post_recv_a(posted)) != 0)
-            return -1;
-          posted++;
-        }
+      return 0;
+    }
+    if (kind != kWrRecv) return 0;
+    std::vector<size_t> &done_c = left ? done_rBc : done_rAc;
+    std::vector<uint8_t> &mask = left ? mask_rB : mask_rA;
+    if (idx >= mask.size() || mask[idx] ||
+        idx != chan + done_c[chan] * nc) {
+      tdr::set_error("ring(fused2): out-of-order recv completion on "
+                     "channel " + std::to_string(chan));
+      return -1;
+    }
+    mask[idx] = 1;
+    done_c[chan]++;
+    if (left) {
+      done_rB++;
+      while (fr_rB < n_b && mask_rB[fr_rB]) fr_rB++;
+    } else {
+      done_rA++;
+    }
+    return 0;
+  }
+
+  // Oldest outstanding completion: the B fold stream first (it gates
+  // the reduced-return sends), then the A final stream, then send
+  // acks on either side.
+  void owed_channel(bool *left, size_t *chan) {
+    size_t c = 0;
+    if (oldest_outstanding(pc_rB, done_rBc, nc, &c) !=
+        static_cast<size_t>(-1)) {
+      *left = true;
+      *chan = c;
+      return;
+    }
+    if (!use_fb && oldest_outstanding(pc_rA, done_rAc, nc, &c) !=
+                       static_cast<size_t>(-1)) {
+      *left = false;
+      *chan = c;
+      return;
+    }
+    for (size_t i = 0; i < nc; i++) {
+      if (pc_sA[i] > ac_sA[i]) {
+        *left = false;
+        *chan = i;
+        return;
+      }
+      if (pc_sB[i] > ac_sB[i]) {
+        *left = true;
+        *chan = i;
+        return;
       }
     }
-    return n;
+    *left = true;
+    *chan = 0;
   }
 
   int run() {
-    // Pre-post the inbound streams deep: every target is a disjoint
-    // slice of the data MR (folds for B, final placement for A), so
-    // the QP depth — and, for staged-fold engines, the reduce-recv
-    // slot budget — bounds the window. In foldback mode there is no
-    // A-final stream — the send ack carries that meaning.
-    const size_t rb_win = reduce_recv_window(r->left);
-    for (; posted_rB < std::min(n_b, rb_win); posted_rB++)
-      if (post_recv_b(posted_rB) != 0) return -1;
-    if (!use_fb)
-      for (; posted_rA < std::min(n_a, kMaxOutstanding); posted_rA++)
-        if (post_recv_a(posted_rA) != 0) return -1;
-    if (use_fb) done_rA = n_a;          // stream does not exist
+    nc = r->lefts.size();
+    mask_rB.assign(n_b, 0);
+    mask_rA.assign(use_fb ? 0 : n_a, 0);
+    done_rBc.assign(nc, 0);
+    done_rAc.assign(nc, 0);
+    pc_rB.assign(nc, 0);
+    pc_rA.assign(nc, 0);
+    pc_sA.assign(nc, 0);
+    ac_sA.assign(nc, 0);
+    pc_sB.assign(nc, 0);
+    ac_sB.assign(nc, 0);
+    rb_win.assign(nc, 0);
+    sa_win.assign(nc, 0);
+    for (size_t c = 0; c < nc; c++) {
+      rb_win[c] = reduce_recv_window(r->lefts[c]);
+      // A-chunks land in the peer's reduce-recvs: bound in-flight
+      // sends by its window (≈ ours) so a real HCA doesn't
+      // RNR-NAK-storm.
+      sa_win[c] = reduce_recv_window(r->rights[c]);
+    }
+    if (use_fb) done_rA = n_a;                // stream does not exist
     const size_t need_sB = use_fb ? 0 : n_b;  // ditto
-    // A-chunks land in the peer's reduce-recvs: bound in-flight sends
-    // by its window (≈ ours) so a real HCA doesn't RNR-NAK-storm.
-    const size_t sa_win = reduce_recv_window(r->right);
 
-    while (done_rB < n_b || acked_sB < need_sB || done_rA < n_a ||
-           acked_sA < n_a) {
+    // Post the inbound streams deep (every target is a disjoint slice
+    // of the data MR) and the outbound streams as their gates open,
+    // all in global chunk order — which is per-channel FIFO order.
+    auto post_more = [&]() -> int {
       bool progressed = false;
-      if (posted_sA < n_a && posted_sA - acked_sA < sa_win) {
+      while (posted_rB < n_b &&
+             pc_rB[posted_rB % nc] - done_rBc[posted_rB % nc] <
+                 rb_win[posted_rB % nc]) {
+        if (post_recv_b(posted_rB) != 0) return -1;
+        posted_rB++;
+        progressed = true;
+      }
+      if (!use_fb) {
+        while (posted_rA < n_a &&
+               pc_rA[posted_rA % nc] - done_rAc[posted_rA % nc] <
+                   kMaxOutstanding) {
+          if (post_recv_a(posted_rA) != 0) return -1;
+          posted_rA++;
+          progressed = true;
+        }
+      }
+      while (posted_sA < n_a &&
+             pc_sA[posted_sA % nc] - ac_sA[posted_sA % nc] <
+                 sa_win[posted_sA % nc]) {
+        size_t c = posted_sA % nc;
         int rc = use_fb
-                     ? tdr_post_send_foldback(r->right, dmr,
+                     ? tdr_post_send_foldback(r->rights[c], dmr,
                                               a_off + posted_sA * chunk,
                                               clen(a_len, posted_sA),
                                               kWrSend | posted_sA)
-                     : tdr_post_send(r->right, dmr, a_off + posted_sA * chunk,
+                     : tdr_post_send(r->rights[c], dmr,
+                                     a_off + posted_sA * chunk,
                                      clen(a_len, posted_sA),
                                      kWrSend | posted_sA);
         if (rc != 0) return -1;
+        pc_sA[c]++;
         posted_sA++;
         progressed = true;
       }
       // Non-foldback: return a reduced B chunk the moment its fold
-      // completes (cache-hot). Foldback mode returns it inside the
-      // fold itself.
-      if (!use_fb && posted_sB < done_rB &&
-          posted_sB - acked_sB < kMaxOutstanding) {
-        if (tdr_post_send(r->left, dmr, b_off + posted_sB * chunk,
+      // completes (cache-hot). The gate is the in-order folded
+      // prefix, so the peer's rA stream sees its per-channel FIFO.
+      while (!use_fb && posted_sB < fr_rB &&
+             posted_sB - acked_sB < kMaxOutstanding) {
+        size_t c = posted_sB % nc;
+        if (tdr_post_send(r->lefts[c], dmr, b_off + posted_sB * chunk,
                           clen(b_len, posted_sB), kWrSend | posted_sB) != 0)
           return -1;
+        pc_sB[c]++;
         posted_sB++;
         progressed = true;
       }
-      int nl = drain(true, 0);
+      return progressed ? 1 : 0;
+    };
+
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(ring_timeout_ms());
+    while (done_rB < n_b || acked_sB < need_sB || done_rA < n_a ||
+           acked_sA < n_a) {
+      int p = post_more();
+      if (p < 0) return -1;
+      int nl = sweep_side(r->lefts, *this, true);
       if (nl < 0) return -1;
-      int nr = drain(false, 0);
+      int nr = sweep_side(r->rights, *this, false);
       if (nr < 0) return -1;
-      // Reaped completions count as progress: the loop condition must
-      // be re-evaluated before blocking, or the final completion can
-      // be consumed right here and the blocking poll waits on nothing.
-      if (nl > 0 || nr > 0) progressed = true;
-      if (!progressed) {
-        // Nothing postable: block on the side that still owes us
-        // completions (progress threads keep both moving regardless).
-        bool left_owes =
-            done_rB < n_b || acked_sB < posted_sB;
-        int n = drain(left_owes, ring_timeout_ms());
-        if (n < 0) return -1;
-        if (n == 0) {
-          tdr::set_error(
-              "ring(fused2): poll timeout (rB " + std::to_string(done_rB) +
-              "/" + std::to_string(n_b) + " sB " + std::to_string(acked_sB) +
-              "/" + std::to_string(posted_sB) + " rA " +
-              std::to_string(done_rA) + "/" + std::to_string(n_a) + " sA " +
-              std::to_string(acked_sA) + "/" + std::to_string(posted_sA) +
-              ")");
-          return -1;
-        }
+      if (p > 0 || nl > 0 || nr > 0) {
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::milliseconds(ring_timeout_ms());
+        continue;
+      }
+      int n = wait_owed(r, *this, 50);
+      if (n < 0) return -1;
+      if (n > 0) {
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::milliseconds(ring_timeout_ms());
+        continue;
+      }
+      if (std::chrono::steady_clock::now() >= deadline) {
+        tdr::set_error(
+            "ring(fused2): poll timeout (rB " + std::to_string(done_rB) +
+            "/" + std::to_string(n_b) + " sB " + std::to_string(acked_sB) +
+            "/" + std::to_string(posted_sB) + " rA " +
+            std::to_string(done_rA) + "/" + std::to_string(n_a) + " sA " +
+            std::to_string(acked_sA) + "/" + std::to_string(posted_sA) +
+            ")");
+        return -1;
       }
     }
     return 0;
@@ -632,106 +1052,163 @@ struct WaveItem {
 };
 
 struct Wavefront {
-  tdr_ring *r;
-  tdr_mr *dmr;
-  int dtype, red_op;
+  tdr_ring *r = nullptr;
+  tdr_mr *dmr = nullptr;
+  int dtype = 0, red_op = 0;
   std::vector<WaveItem> sends, recvs;
 
+  size_t nc = 1;
   size_t posted_s = 0, acked_s = 0, posted_r = 0, done_r = 0;
   // Completion bookkeeping tolerates out-of-schedule-order recv
-  // completions: a foldback recv's completion is DEFERRED until the
-  // peer's write-back pull acks, so a later plain recv can complete
-  // first. Matching is still FIFO at the transport — only the
-  // reporting reorders — and send dependencies use the in-order
-  // completed PREFIX (frontier), never the raw count.
+  // completions: channels complete independently, and a foldback
+  // recv's completion is DEFERRED until the peer's write-back pull
+  // acks, so a later recv can complete first. Matching is still FIFO
+  // per channel at the transport — only cross-channel reporting
+  // reorders — and send dependencies use the in-order completed
+  // PREFIX (frontier), never the raw count.
   std::vector<uint8_t> done_mask;
   size_t frontier = 0;
+  // Per-channel in-flight accounting (window bounds) and send acks.
+  std::vector<size_t> pc_r, dc_r, pc_s, ac_s;
+  std::vector<size_t> r_win;
 
   int post_send_item(size_t i) {
     const WaveItem &it = sends[i];
-    if (it.fb)
-      return tdr_post_send_foldback(r->right, dmr, it.off, it.len,
-                                    kWrSend | i);
-    return tdr_post_send(r->right, dmr, it.off, it.len, kWrSend | i);
+    tdr_qp *qp = r->rights[i % nc];
+    int rc = it.fb
+                 ? tdr_post_send_foldback(qp, dmr, it.off, it.len,
+                                          kWrSend | i)
+                 : tdr_post_send(qp, dmr, it.off, it.len, kWrSend | i);
+    if (rc == 0) pc_s[i % nc]++;
+    return rc;
   }
   int post_recv_item(size_t i) {
     const WaveItem &it = recvs[i];
-    if (it.reduce)
-      return tdr_post_recv_reduce(r->left, dmr, it.off, it.len, dtype,
-                                  red_op, kWrRecv | i);
-    return tdr_post_recv(r->left, dmr, it.off, it.len, kWrRecv | i);
+    tdr_qp *qp = r->lefts[i % nc];
+    int rc = it.reduce
+                 ? tdr_post_recv_reduce(qp, dmr, it.off, it.len, dtype,
+                                        red_op, kWrRecv | i)
+                 : tdr_post_recv(qp, dmr, it.off, it.len, kWrRecv | i);
+    if (rc == 0) pc_r[i % nc]++;
+    return rc;
   }
 
-  int drain(bool left, int timeout_ms) {
-    tdr_wc wc[16];
-    tdr_qp *qp = left ? r->left : r->right;
-    int n = tdr_poll(qp, wc, 16, timeout_ms);
-    if (n < 0) return -1;
-    for (int i = 0; i < n; i++) {
-      if (wc[i].status != TDR_WC_SUCCESS) {
-        tdr::set_error("ring(wave): completion error status " +
-                       wc_status_label(wc[i].status));
+  int on_wc(bool left, size_t chan, const tdr_wc &wc) {
+    (void)left;
+    if (wc.status != TDR_WC_SUCCESS) {
+      tdr::set_error("ring(wave): completion error status " +
+                     wc_status_label(wc.status));
+      return -1;
+    }
+    uint64_t kind = wc.wr_id & kWrKindMask;
+    size_t idx = wc.wr_id & ~kWrKindMask;
+    if (kind == kWrSend) {
+      acked_s++;
+      ac_s[idx % nc]++;
+    } else if (kind == kWrRecv) {
+      if (idx >= done_mask.size() || done_mask[idx] || idx % nc != chan) {
+        tdr::set_error("ring(wave): duplicate/foreign recv completion");
         return -1;
       }
-      uint64_t kind = wc[i].wr_id & kWrKindMask;
-      size_t idx = wc[i].wr_id & ~kWrKindMask;
-      if (kind == kWrSend) {
-        acked_s++;
-      } else if (kind == kWrRecv) {
-        if (idx >= done_mask.size() || done_mask[idx]) {
-          tdr::set_error("ring(wave): duplicate/foreign recv completion");
-          return -1;
-        }
-        done_mask[idx] = 1;
-        done_r++;
-        while (frontier < done_mask.size() && done_mask[frontier])
-          frontier++;
+      done_mask[idx] = 1;
+      dc_r[chan]++;
+      done_r++;
+      while (frontier < done_mask.size() && done_mask[frontier])
+        frontier++;
+    }
+    return 0;
+  }
+
+  // The frontier's channel owes the oldest outstanding recv (it is
+  // what every send dependency waits on); else any channel owing a
+  // send ack.
+  void owed_channel(bool *left, size_t *chan) {
+    size_t c = 0;
+    if (oldest_outstanding(pc_r, dc_r, nc, &c) != static_cast<size_t>(-1)) {
+      *left = true;
+      *chan = c;
+      return;
+    }
+    for (size_t i = 0; i < nc; i++) {
+      if (pc_s[i] > ac_s[i]) {
+        *left = false;
+        *chan = i;
+        return;
       }
     }
-    return n;
+    *left = true;
+    *chan = 0;
   }
 
   int run() {
+    nc = r->lefts.size();
     const size_t N = sends.size(), M = recvs.size();
     done_mask.assign(M, 0);
-    // Mixed reduce/place recv stream: bound the whole window by the
-    // engine's reduce-recv budget (conservative for place-only spans,
-    // but the window refills as completions retire).
-    const size_t r_win = reduce_recv_window(r->left);
-    while (acked_s < N || done_r < M) {
+    pc_r.assign(nc, 0);
+    dc_r.assign(nc, 0);
+    pc_s.assign(nc, 0);
+    ac_s.assign(nc, 0);
+    r_win.assign(nc, 0);
+    // Mixed reduce/place recv stream: bound each channel's window by
+    // its engine-side reduce-recv budget (conservative for place-only
+    // spans, but the window refills as completions retire).
+    for (size_t c = 0; c < nc; c++)
+      r_win[c] = reduce_recv_window(r->lefts[c]);
+
+    auto post_more = [&]() -> int {
       bool progressed = false;
-      // Keep the recv window deep (disjoint targets; FIFO-matched).
-      while (posted_r < M && posted_r - done_r < r_win) {
+      // Keep the recv windows deep (disjoint targets; per-channel
+      // FIFO-matched because global order IS per-channel order).
+      while (posted_r < M &&
+             pc_r[posted_r % nc] - dc_r[posted_r % nc] <
+                 r_win[posted_r % nc]) {
         if (post_recv_item(posted_r) != 0) return -1;
         posted_r++;
         progressed = true;
       }
       // Post sends strictly in schedule order as their dependency
       // (the same-segment recv of the previous step) completes.
-      // In-flight sends bounded by the peer's recv window (≈ r_win;
-      // symmetric schedule) to avoid RNR storms on real HCAs.
-      while (posted_s < N && posted_s - acked_s < r_win &&
+      // In-flight sends bounded by the peer's per-channel recv window
+      // (≈ r_win; symmetric schedule) to avoid RNR storms on real
+      // HCAs.
+      while (posted_s < N &&
+             pc_s[posted_s % nc] - ac_s[posted_s % nc] <
+                 r_win[posted_s % nc] &&
              frontier >= sends[posted_s].dep) {
         if (post_send_item(posted_s) != 0) return -1;
         posted_s++;
         progressed = true;
       }
-      int nl = drain(true, 0);
+      return progressed ? 1 : 0;
+    };
+
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(ring_timeout_ms());
+    while (acked_s < N || done_r < M) {
+      int p = post_more();
+      if (p < 0) return -1;
+      int nl = sweep_side(r->lefts, *this, true);
       if (nl < 0) return -1;
-      int nr = drain(false, 0);
+      int nr = sweep_side(r->rights, *this, false);
       if (nr < 0) return -1;
-      if (nl > 0 || nr > 0) progressed = true;
-      if (!progressed) {
-        bool left_owes = done_r < M;
-        int n = drain(left_owes, ring_timeout_ms());
-        if (n < 0) return -1;
-        if (n == 0) {
-          tdr::set_error("ring(wave): poll timeout (s " +
-                         std::to_string(acked_s) + "/" + std::to_string(N) +
-                         " r " + std::to_string(done_r) + "/" +
-                         std::to_string(M) + ")");
-          return -1;
-        }
+      if (p > 0 || nl > 0 || nr > 0) {
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::milliseconds(ring_timeout_ms());
+        continue;
+      }
+      int n = wait_owed(r, *this, 50);
+      if (n < 0) return -1;
+      if (n > 0) {
+        deadline = std::chrono::steady_clock::now() +
+                   std::chrono::milliseconds(ring_timeout_ms());
+        continue;
+      }
+      if (std::chrono::steady_clock::now() >= deadline) {
+        tdr::set_error("ring(wave): poll timeout (s " +
+                       std::to_string(acked_s) + "/" + std::to_string(N) +
+                       " r " + std::to_string(done_r) + "/" +
+                       std::to_string(M) + ")");
+        return -1;
       }
     }
     return 0;
@@ -800,6 +1277,7 @@ int run_ag_phase(StepPipe &pipe, tdr_ring *r,
 }
 
 }  // namespace
+}  // extern "C++"
 
 int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                        int red_op) {
@@ -867,15 +1345,16 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
   if (world == 2 && r->left != r->right &&
       tdr_qp_has_recv_reduce(r->left) && tdr_qp_has_fused2(r->left) &&
       tdr_qp_has_fused2(r->right)) {
-    FusedTwo f{r,
-               dmr,
-               dtype,
-               red_op,
-               r->chunk,
-               seg_off[r->rank],
-               seg_len[r->rank],
-               seg_off[1 - r->rank],
-               seg_len[1 - r->rank]};
+    FusedTwo f;
+    f.r = r;
+    f.dmr = dmr;
+    f.dtype = dtype;
+    f.red_op = red_op;
+    f.chunk = r->chunk;
+    f.a_off = seg_off[r->rank];
+    f.a_len = seg_len[r->rank];
+    f.b_off = seg_off[1 - r->rank];
+    f.b_len = seg_len[1 - r->rank];
     f.n_a = FusedTwo::nchunks(f.a_len, f.chunk);
     f.n_b = FusedTwo::nchunks(f.b_len, f.chunk);
     // Foldback is a NEGOTIATED capability (both ends advertised it in
@@ -925,7 +1404,11 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                          tdr_qp_has_send_foldback(r->left) &&
                          !tdr::env_set("TDR_NO_WAVE_FB");
     const int eff_steps = wave_fb ? steps - 1 : steps;
-    Wavefront wf{r, dmr, dtype, red_op, {}, {}, 0, 0, 0, 0, {}, 0};
+    Wavefront wf;
+    wf.r = r;
+    wf.dmr = dmr;
+    wf.dtype = dtype;
+    wf.red_op = red_op;
     std::vector<size_t> rprefix(steps + 1, 0);
     for (int t = 0; t < steps; t++) {
       int ss, rs;

@@ -82,7 +82,7 @@ const char *kEventNames[] = {
     "none",       "post_send", "post_recv", "post_write", "post_read",
     "wire_tx",    "wire_rx",   "land",      "verify_ok",  "verify_fail",
     "nak",        "retx",      "fold",      "wc",         "copy_enq",
-    "copy_run",   "ring_begin", "ring_end",
+    "copy_run",   "ring_begin", "ring_end", "fold_off",
 };
 constexpr int kEventCount =
     static_cast<int>(sizeof(kEventNames) / sizeof(kEventNames[0]));
@@ -229,7 +229,7 @@ const char *kCounterNames[] = {
     "integrity.sealed",   "integrity.verified", "integrity.failed",
     "integrity.retransmitted", "fault.seen",    "fault.hits",
     "copy.nt_bytes",      "copy.plain_bytes",   "telemetry.recorded",
-    "telemetry.dropped",
+    "telemetry.dropped",  "fold.jobs",          "fold.busy_us",
 };
 constexpr int kRegistryCount =
     static_cast<int>(sizeof(kCounterNames) / sizeof(kCounterNames[0]));
@@ -245,6 +245,8 @@ void read_all(uint64_t out[kRegistryCount]) {
   tdr::copy_counters(&out[6], &out[7]);
   out[8] = tdr::g_recorded.load(std::memory_order_relaxed);
   out[9] = tdr::g_dropped.load(std::memory_order_relaxed);
+  out[10] = tdr::fold_jobs();
+  out[11] = tdr::fold_busy_us();
 }
 
 }  // namespace

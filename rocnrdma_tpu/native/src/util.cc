@@ -9,6 +9,10 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#endif
+
 #include <atomic>
 #include <chrono>
 #include <cstdlib>
@@ -43,6 +47,10 @@ uint32_t local_features() {
     f |= FEAT_FOLDBACK;
   if (!env_set("TDR_NO_FUSED2")) f |= FEAT_FUSED2;
   if (!env_set("TDR_NO_SEAL")) f |= FEAT_SEAL;
+  // Full payload CRC on the CMA tier is an OPT-IN (tests forcing the
+  // whole detect→NAK→retransmit ladder over same-host worlds); the
+  // default there seals the tag only — see FEAT_SEAL_CMA_FULL.
+  if (env_set("TDR_SEAL_CMA")) f |= FEAT_SEAL_CMA_FULL;
   return f;
 }
 
@@ -142,6 +150,33 @@ size_t dtype_size(int dt) {
       return 0;
   }
 }
+
+// ------------------------------------------------------------------
+// Vectorized f32 sum — the fold kernel the ring's phase-1 reduction
+// spends most of its ALU time in. ISA-guarded explicitly (AVX → SSE →
+// scalar) instead of trusting autovectorization: the scratch-window
+// fold now runs on dedicated fold workers where a scalar loop would
+// make the offload pointless. Element-wise float adds, so the result
+// is bitwise identical to the scalar loop regardless of the path.
+
+namespace {
+
+void sum_f32(float *dst, const float *src, size_t n) {
+  size_t i = 0;
+#if defined(__AVX__)
+  for (; i + 8 <= n; i += 8)
+    _mm256_storeu_ps(dst + i,
+                     _mm256_add_ps(_mm256_loadu_ps(dst + i),
+                                   _mm256_loadu_ps(src + i)));
+#elif defined(__SSE__)
+  for (; i + 4 <= n; i += 4)
+    _mm_storeu_ps(dst + i,
+                  _mm_add_ps(_mm_loadu_ps(dst + i), _mm_loadu_ps(src + i)));
+#endif
+  for (; i < n; i++) dst[i] += src[i];
+}
+
+}  // namespace
 
 namespace {
 
@@ -304,6 +339,11 @@ void reduce2_any(void *dst, void *src, size_t n, int dt, int op) {
 void reduce_any(void *dst, const void *src, size_t n, int dt, int op) {
   switch (dt) {
     case TDR_DT_F32:
+      if (op == TDR_RED_SUM) {
+        sum_f32(static_cast<float *>(dst), static_cast<const float *>(src),
+                n);
+        break;
+      }
       reduce_typed(static_cast<float *>(dst), static_cast<const float *>(src),
                    n, op);
       break;

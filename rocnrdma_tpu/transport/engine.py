@@ -192,6 +192,18 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_poll.argtypes = [P, ctypes.POINTER(Wc), ctypes.c_int, ctypes.c_int]
     lib.tdr_ring_create.restype = P
     lib.tdr_ring_create.argtypes = [P, P, P, ctypes.c_int, ctypes.c_int]
+    lib.tdr_ring_create_channels.restype = P
+    lib.tdr_ring_create_channels.argtypes = [
+        P, ctypes.POINTER(P), ctypes.POINTER(P), ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+    ]
+    lib.tdr_ring_channels.restype = ctypes.c_int
+    lib.tdr_ring_channels.argtypes = [P]
+    lib.tdr_ring_chunk_bytes.restype = ctypes.c_size_t
+    lib.tdr_ring_chunk_bytes.argtypes = []
+    lib.tdr_fold_pool_workers.restype = ctypes.c_size_t
+    lib.tdr_qp_has_seal_payload.restype = ctypes.c_int
+    lib.tdr_qp_has_seal_payload.argtypes = [P]
     lib.tdr_ring_register.restype = ctypes.c_int
     lib.tdr_ring_register.argtypes = [P, P, ctypes.c_size_t]
     lib.tdr_ring_unregister.restype = ctypes.c_int
@@ -327,6 +339,31 @@ def copy_pool_workers() -> int:
     """Worker count of the native parallel copy/reduce pool (the
     emulated NIC's DMA-engine array; TDR_COPY_THREADS overrides)."""
     return int(_load().tdr_copy_pool_workers())
+
+
+def fold_pool_workers() -> int:
+    """Worker count of the fold-offload pool (TDR_FOLD_THREADS): the
+    threads that run the ring's scratch-window folds off the poll
+    loop. 0 = folds run inline (1-core hosts or the knob set to 0)."""
+    return int(_load().tdr_fold_pool_workers())
+
+
+def ring_chunk_bytes() -> int:
+    """EFFECTIVE ring chunk size in bytes (TDR_RING_CHUNK override or
+    the native built-in default) — the value schedule digests hash:
+    the raw env string would hide a changed built-in default."""
+    return int(_load().tdr_ring_chunk_bytes())
+
+
+def ring_channels_default() -> int:
+    """The channel count RingWorld uses when TDR_RING_CHANNELS is
+    unset (clamped to [1, 16])."""
+    env = os.environ.get("TDR_RING_CHANNELS", "")
+    try:
+        v = int(env) if env else 4
+    except ValueError:
+        v = 4
+    return max(1, min(v, 16))
 
 
 def copy_counters() -> Tuple[int, int]:
@@ -689,6 +726,16 @@ class QueuePair:
         return bool(_load().tdr_qp_has_seal(_live(self._h, "has_seal")))
 
     @property
+    def has_seal_payload(self) -> bool:
+        """Whether the negotiated seal's CRC covers the PAYLOAD bytes:
+        always on the TCP stream tier; on the CMA tier only when both
+        ends set TDR_SEAL_CMA=1 (the default there is tag-only — the
+        kernel-memcpy \"wire\" has no payload bit-flip failure mode,
+        the same rationale as the verbs backend's ICRC stance)."""
+        return bool(_load().tdr_qp_has_seal_payload(
+            _live(self._h, "has_seal_payload")))
+
+    @property
     def telemetry_id(self) -> int:
         """Flight-recorder track id of this QP (bring-up ordinal;
         names the per-QP timeline in Perfetto exports)."""
@@ -728,14 +775,35 @@ class QueuePair:
 
 
 class Ring:
-    """Native ring-allreduce context over neighbor QPs."""
+    """Native ring-allreduce context over neighbor QPs.
 
-    def __init__(self, engine: "Engine", left: QueuePair, right: QueuePair,
-                 rank: int, world: int):
-        self._h = _load().tdr_ring_create(engine._h, left._h, right._h,
-                                          rank, world)
+    ``left``/``right`` may each be a single QueuePair (the classic
+    single-QP ring) or a sequence of QueuePairs — one per channel —
+    in which case the striped schedules route chunk i over channel
+    i % channels (``lefts[c]`` here must be connected to ``rights[c]``
+    on the left neighbor; RingWorld's bootstrap guarantees it by
+    bringing channels up in index order)."""
+
+    def __init__(self, engine: "Engine", left, right, rank: int,
+                 world: int):
+        lefts = list(left) if isinstance(left, (list, tuple)) else [left]
+        rights = (list(right) if isinstance(right, (list, tuple))
+                  else [right])
+        if len(lefts) != len(rights) or not lefts:
+            raise TransportError("ring_create: mismatched channel lists")
+        n = len(lefts)
+        P = ctypes.c_void_p
+        la = (P * n)(*[_live(q._h, "ring_create left") for q in lefts])
+        ra = (P * n)(*[_live(q._h, "ring_create right") for q in rights])
+        self._h = _load().tdr_ring_create_channels(engine._h, la, ra, n,
+                                                   rank, world)
         _check(self._h, "ring_create")
         self.rank, self.world = rank, world
+
+    @property
+    def channels(self) -> int:
+        """Channel count (independent QPs per neighbor) of this ring."""
+        return int(_load().tdr_ring_channels(_live(self._h, "channels")))
 
     def register_buffer(self, array) -> None:
         """Front-load MR registration for a buffer the caller promises

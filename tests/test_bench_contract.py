@@ -65,3 +65,85 @@ def test_details_file_exists_and_carries_the_bulk(bench_run):
     assert "sweep_write" in details
     assert "roofline_fold_GBps" in details
     assert details["quick_mode"] is True
+
+
+def test_bench_record_carries_channel_sweep_and_fold_occupancy(bench_run):
+    """BENCH_r06 contract: the machine-readable record carries the
+    multi-channel sweep (per-channel-count bus bandwidth for
+    TDR_RING_CHANNELS in {1,2,4,8}) and the fold-offload occupancy of
+    the windowed-scratch run — quick mode writes the identical schema
+    beside the details file."""
+    out = json.loads(bench_run.stdout.splitlines()[-1])
+    details_path = out["details_file"]
+    if not os.path.isabs(details_path):
+        details_path = os.path.join(REPO, details_path)
+    record_path = os.path.join(os.path.dirname(details_path),
+                               out["bench_record"])
+    with open(record_path) as f:
+        record = json.load(f)
+    by_ch = record["allreduce_world4_by_channels"]
+    assert set(by_ch) == {"1", "2", "4", "8"}, by_ch
+    assert all(isinstance(v, (int, float)) and v > 0
+               for v in by_ch.values()), by_ch
+    assert record["allreduce_world4_channels"] in (1, 2, 4, 8)
+    fold = record["fold_offload"]
+    assert "threads" in fold and "occupancy_by_channels" in fold
+    windowed = fold["windowed"]
+    assert windowed["bus_GBps"] > 0
+    assert windowed["fold_offload_occupancy"] >= 0
+    # vs_bound rides the record too (the acceptance headline).
+    assert "allreduce_world4_vs_bound" in record
+    assert "staged_pipelined" in record["bw_GBps"]
+    assert "staged_serial" in record["bw_GBps"]
+
+
+def test_channels_one_reproduces_legacy_single_qp_digest():
+    """Contract twin of tests/test_multichannel.py's digest test, kept
+    here with the bench record assertions the satellite names: a
+    channels=1 world's schedule-digest string carries no ``chan=``
+    term (the legacy single-QP digest), so digest caches and
+    cross-version worlds at channels=1 interoperate."""
+    import hashlib
+
+    import numpy as np
+
+    from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+    from rocnrdma_tpu.collectives.world import RingWorld, local_worlds
+    from test_transport import free_port
+
+    captured = {}
+    orig = RingWorld.check_schedule
+
+    def spy(self, digest, describe=""):
+        captured[self.rank] = (digest, describe)
+        return orig(self, digest, describe)
+
+    env = os.environ.get("TDR_RING_CHANNELS")
+    os.environ["TDR_RING_CHANNELS"] = "1"
+    RingWorld.check_schedule = spy
+    try:
+        import threading
+
+        worlds = local_worlds(2, free_port())
+        shims = [CrossSliceAllReduce(w) for w in worlds]
+        trees = [[np.ones(64, dtype=np.float32)] for _ in range(2)]
+        ts = [threading.Thread(target=shims[r], args=(trees[r],))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for s in shims:
+            s.close()
+        for w in worlds:
+            w.close()
+    finally:
+        RingWorld.check_schedule = orig
+        if env is None:
+            os.environ.pop("TDR_RING_CHANNELS", None)
+        else:
+            os.environ["TDR_RING_CHANNELS"] = env
+    digest, describe = captured[0]
+    assert "chan=" not in describe, describe
+    # The digest is exactly sha256 of the legacy describe string.
+    assert digest == hashlib.sha256(describe.encode()).digest()

@@ -154,11 +154,16 @@ def test_send_corruption_detected_and_healed(fault_plan, loop):
     assert trace.counter("integrity.retransmitted") >= 1
 
 
-def test_land_corruption_detected_and_healed(fault_plan, loop):
+def test_land_corruption_detected_and_healed(fault_plan, monkeypatch):
     """``land:nth=1:corrupt=2``: bytes flipped after materialization,
-    before verification — the receive-side half of the fault model."""
+    before verification — the receive-side half of the fault model.
+    Land-site detection needs the payload CRC, so this pins FULL CMA
+    sealing (TDR_SEAL_CMA=1; the same-host default is tag-only)."""
+    monkeypatch.setenv("TDR_SEAL_CMA", "1")
     fault_plan("land:nth=1:corrupt=2")
-    e, a, b = loop
+    e = Engine("emu")
+    a, b = loopback_pair(e, free_port())
+    assert a.has_seal_payload and b.has_seal_payload
     msg = np.full(256, 7, dtype=np.uint8)
     inbox = np.zeros(256, dtype=np.uint8)
     with e.reg_mr(msg) as smr, e.reg_mr(inbox) as rmr:
@@ -169,6 +174,54 @@ def test_land_corruption_detected_and_healed(fault_plan, loop):
     assert (inbox == 7).all()
     assert fault_plan_hits(0) == 1
     assert seal_counters()["failed"] >= 1
+    a.close(); b.close(); e.close()
+
+
+def test_cma_seal_defaults_to_tag_only(monkeypatch):
+    """The CMA tier's negotiated default is tag-only sealing (the
+    kernel-memcpy "wire" has no payload bit-flip failure mode — the
+    verbs ICRC rationale): has_seal stays on, has_seal_payload is off,
+    and the generation fence still works. TDR_SEAL_CMA=1 on BOTH ends
+    reinstates the payload CRC; the TCP stream tier (TDR_NO_CMA)
+    always carries it."""
+    e = Engine("emu")
+    a, b = loopback_pair(e, free_port())
+    assert a.has_seal and b.has_seal
+    assert not a.has_seal_payload and not b.has_seal_payload
+    a.close(); b.close(); e.close()
+
+    monkeypatch.setenv("TDR_SEAL_CMA", "1")
+    e = Engine("emu")
+    a, b = loopback_pair(e, free_port())
+    assert a.has_seal_payload and b.has_seal_payload
+    a.close(); b.close(); e.close()
+    monkeypatch.delenv("TDR_SEAL_CMA")
+
+    monkeypatch.setenv("TDR_NO_CMA", "1")
+    e = Engine("emu")
+    a, b = loopback_pair(e, free_port())
+    assert a.has_seal and a.has_seal_payload and b.has_seal_payload
+    a.close(); b.close(); e.close()
+
+
+def test_tag_only_send_corruption_detected_and_healed(fault_plan, loop):
+    """Even in tag-only mode a send-site corrupt clause (CRC flip on
+    desc frames) is detected and healed by the NAK/retransmit ladder —
+    the tag CRC still travels and still gates every landing."""
+    e, a, b = loop
+    assert a.has_seal and not a.has_seal_payload
+    fault_plan("send:nth=1:corrupt=3")
+    msg = np.arange(64, dtype=np.uint8)
+    inbox = np.zeros(64, dtype=np.uint8)
+    with e.reg_mr(msg) as smr, e.reg_mr(inbox) as rmr:
+        b.post_recv(rmr, 0, 64, wr_id=1)
+        a.post_send(smr, 0, 64, wr_id=2)
+        assert a.wait(2, timeout_ms=10000).ok
+        assert b.wait(1, timeout_ms=10000).ok
+    np.testing.assert_array_equal(inbox, msg)
+    c = seal_counters()
+    assert c["failed"] >= 1 and c["retransmitted"] >= 1, c
+    assert fault_plan_hits(0) == 1
 
 
 def test_corrupt_chunk_never_folded_before_verify(fault_plan, loop):
@@ -263,13 +316,16 @@ def test_stale_incarnation_ghost_write_fenced(fault_plan, monkeypatch):
 # ------------------------------------------------- ring-level ladder
 
 
-def test_ring_corruption_heals_bitwise_equal(fault_plan):
+def test_ring_corruption_heals_bitwise_equal(fault_plan, monkeypatch):
     """Deterministic corruption soak at the collective level: a
     corrupted chunk on a world-2 sealed allreduce is detected,
     retransmitted, and the result is BITWISE equal to an
-    uninterrupted run — the caller never sees an error."""
+    uninterrupted run — the caller never sees an error. Full CMA
+    sealing is pinned (TDR_SEAL_CMA=1): the land-site clause flips
+    payload bytes, which only the payload CRC can catch."""
     from rocnrdma_tpu.collectives.world import local_worlds
 
+    monkeypatch.setenv("TDR_SEAL_CMA", "1")
     # Clean reference run first.
     worlds = local_worlds(2, free_port())
     clean = [np.full(4096, float(r + 1), dtype=np.float32)

@@ -1,0 +1,111 @@
+"""Staged-pipeline overlap tests.
+
+BENCH_r05 showed staged "pipelined" == serial in throughput; the fix
+is asserted here STRUCTURALLY, not by timing a ratio: the Python
+tracer's stage spans (xslice.stage_gather / stage_ring /
+stage_scatter, all on the flight-recorder clock) must show segment
+k+1's gather STARTING before segment k's ring op ENDS — the copy for
+the next chunk is issued while the previous chunk is on the wire.
+Throughput ratios on a CPU-saturated host are ~1 by construction (see
+bench.py's staged_note); interleaving is the invariant that transfers
+to hosts where the staging copies ride a DMA engine.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+from rocnrdma_tpu.collectives.world import local_worlds
+from rocnrdma_tpu.utils.trace import trace
+
+from test_transport import free_port
+
+
+def _spans(name):
+    """[(rank, seg, start, end)] for one span family (span events are
+    recorded at END with dur_s)."""
+    out = []
+    for ts, _, fields in trace.events(name):
+        out.append((fields.get("rank"), fields["seg"],
+                    ts - fields["dur_s"], ts))
+    return out
+
+
+def _run_staged(nleaves, leaf_elems, pipelined, monkeypatch):
+    monkeypatch.setenv("TDR_STAGE_PIPELINE", "1" if pipelined else "0")
+    worlds = local_worlds(2, free_port())
+    shims = [CrossSliceAllReduce(w) for w in worlds]
+    trees = [[(np.arange(leaf_elems, dtype=np.float32) % 353) * (r + 1)
+              for _ in range(nleaves)] for r in range(2)]
+    outs = [None, None]
+
+    def run(r):
+        outs[r] = shims[r](trees[r])
+
+    ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for s in shims:
+        s.close()
+    for w in worlds:
+        w.close()
+    return outs
+
+
+def test_pipelined_gather_overlaps_ring(monkeypatch):
+    """For every rank there is at least one (k, k+1) pair where
+    gather(k+1) starts before ring(k) ends — and by construction of
+    the loop, many: the gather is issued at ring-op SUBMIT time."""
+    monkeypatch.setenv("TDR_STAGE_CHUNK", str(256 << 10))
+    _run_staged(nleaves=8, leaf_elems=(256 << 10) // 4,
+                pipelined=True, monkeypatch=monkeypatch)
+    rings = _spans("xslice.stage_ring")
+    gathers = _spans("xslice.stage_gather")
+    assert len({s for _, s, _, _ in rings}) >= 4, \
+        "need several segments for an overlap claim"
+    for rank in (0, 1):
+        ring_end = {s: e for rk, s, _, e in rings if rk == rank}
+        gather_start = {s: b for rk, s, b, _ in gathers if rk == rank}
+        overlapped = [k for k in ring_end
+                      if k + 1 in gather_start
+                      and gather_start[k + 1] < ring_end[k]]
+        assert overlapped, (
+            f"rank {rank}: no gather(k+1) started before ring(k) "
+            f"ended — the staged pipeline is serialized again")
+
+
+def test_serial_mode_does_not_overlap(monkeypatch):
+    """The control: with TDR_STAGE_PIPELINE off the same spans are
+    strictly ordered (gather k+1 starts only after ring k ends) — so
+    the overlap assertion above measures the pipeline, not span
+    bookkeeping noise."""
+    monkeypatch.setenv("TDR_STAGE_CHUNK", str(256 << 10))
+    _run_staged(nleaves=8, leaf_elems=(256 << 10) // 4,
+                pipelined=False, monkeypatch=monkeypatch)
+    rings = _spans("xslice.stage_ring")
+    gathers = _spans("xslice.stage_gather")
+    for rank in (0, 1):
+        ring_end = {s: e for rk, s, _, e in rings if rk == rank}
+        gather_start = {s: b for rk, s, b, _ in gathers if rk == rank}
+        for k, end in ring_end.items():
+            if k + 1 in gather_start:
+                assert gather_start[k + 1] >= end
+
+
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_staged_modes_bitwise_equal(pipelined, monkeypatch):
+    """Pipelined and serial staged syncs produce byte-identical trees
+    (the ring ops run in the same deterministic segment order)."""
+    monkeypatch.setenv("TDR_STAGE_CHUNK", str(128 << 10))
+    outs = _run_staged(nleaves=6, leaf_elems=(128 << 10) // 4,
+                       pipelined=pipelined, monkeypatch=monkeypatch)
+    expect = sum(((np.arange((128 << 10) // 4, dtype=np.float32) % 353)
+                  * (r + 1) for r in range(2)),
+                 np.zeros((128 << 10) // 4, dtype=np.float32))
+    for r in range(2):
+        for leaf in outs[r]:
+            assert np.asarray(leaf).tobytes() == expect.tobytes()

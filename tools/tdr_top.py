@@ -48,7 +48,59 @@ def sparkline(buckets, width=32) -> str:
     return "".join(out)
 
 
-def render(snap: dict) -> str:
+class ChannelLats:
+    """Per-channel (per-QP-lane) chunk latency, from drained events.
+
+    The native chunk_lat_us histogram is process-global; with the ring
+    striped over TDR_RING_CHANNELS QPs, fold-vs-wire imbalance hides
+    inside that aggregate. This accumulator pairs each post_* event
+    with its wc event by (qp, wr_id) and keeps one log2 histogram PER
+    QP lane, so a slow channel (one progress thread stuck folding
+    while its siblings stream) shows up as a fat-tailed lane live."""
+
+    def __init__(self) -> None:
+        self.posts = {}      # (qp, id) -> post ts_ns
+        self.hists = {}      # qp -> [64] counts
+        self.events = 0
+
+    def feed(self, events) -> None:
+        for e in events:
+            self.events += 1
+            if e.name in ("post_send", "post_recv", "post_write",
+                          "post_read") and e.qp:
+                self.posts[(e.qp, e.id)] = e.ts_ns
+            elif e.name == "wc" and e.qp:
+                t0 = self.posts.pop((e.qp, e.id), None)
+                if t0 is None or e.ts_ns <= t0:
+                    continue
+                us = (e.ts_ns - t0) // 1000
+                b = us.bit_length() if us else 0
+                h = self.hists.setdefault(e.qp, [0] * 64)
+                h[min(b, 63)] += 1
+        # Unmatched posts (flushed WRs, drained mid-flight): bound the
+        # pairing table so a soak cannot grow it without limit.
+        if len(self.posts) > 65536:
+            for key in list(self.posts)[:32768]:
+                self.posts.pop(key, None)
+
+    def render(self) -> list:
+        from rocnrdma_tpu.telemetry import hist_percentiles
+
+        lines = []
+        if not self.hists:
+            return lines
+        lines.append("")
+        lines.append("chunk_lat_us by channel (qp lane):")
+        for qp in sorted(self.hists):
+            h = self.hists[qp]
+            p = hist_percentiles(h)
+            lines.append(f"  qp {qp:<4} {'':<8} |{sparkline(h)}| "
+                         f"n={sum(h):<8} p50={p.get('p50', 0):<8} "
+                         f"p90={p.get('p90', 0):<8} p99={p.get('p99', 0)}")
+        return lines
+
+
+def render(snap: dict, chan_lats: "ChannelLats" = None) -> str:
     lines = []
     lines.append("tdr_top — flight recorder  "
                  f"[recording={'ON' if snap.get('enabled') else 'off'} "
@@ -62,6 +114,8 @@ def render(snap: dict) -> str:
         lines.append(f"  {name:<14} |{sparkline(buckets)}| "
                      f"n={sum(buckets):<8} p50={p.get('p50', 0):<8} "
                      f"p90={p.get('p90', 0):<8} p99={p.get('p99', 0)}")
+    if chan_lats is not None:
+        lines.extend(chan_lats.render())
     lines.append("")
     lines.append("counters:")
     counters = snap.get("counters", {})
@@ -123,6 +177,13 @@ def main(argv=None) -> int:
         t = threading.Thread(target=demo_traffic, args=(stop,), daemon=True)
         t.start()
 
+    # Per-channel latency lanes need the raw events (the native
+    # histograms are process-global): live/in-process modes drain the
+    # ring each frame and accumulate per-qp histograms here. The
+    # --file mode watches another process's periodic snapshots — its
+    # events are not reachable, so that mode renders aggregates only.
+    chan_lats = ChannelLats()
+
     def frame() -> str:
         if args.file:
             try:
@@ -134,7 +195,9 @@ def main(argv=None) -> int:
                 return f"snapshot {args.file} mid-write, retrying ..."
         from rocnrdma_tpu import telemetry
 
-        return render(telemetry.snapshot())
+        if telemetry.enabled():
+            chan_lats.feed(telemetry.drain())
+        return render(telemetry.snapshot(), chan_lats)
 
     try:
         if args.once:
