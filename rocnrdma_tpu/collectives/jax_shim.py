@@ -394,6 +394,15 @@ class CrossSliceAllReduce:
         chan = int(getattr(self.world, "channels", 1) or 1)
         if chan != 1:
             sched.append(f"chan={chan}")
+        # Arbitrated worlds stamp the coordinator's membership decision
+        # (world name, generation, membership epoch) into the digest:
+        # two ranks acting on DIFFERENT coordinator views — one missed
+        # a rebuild release — fail the first collective here instead
+        # of desynchronizing. Legacy worlds contribute nothing, so
+        # their digests are preserved byte-for-byte.
+        ctl_stamp = getattr(self.world, "control_stamp", "")
+        if ctl_stamp:
+            sched.append(ctl_stamp)
         # Recv-reduce gating is schedule-selecting too (fused
         # reduce-on-receive vs the windowed-scratch schedule), and it
         # is a PER-PROCESS env knob (TDR_NO_RECV_REDUCE), never

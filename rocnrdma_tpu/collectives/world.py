@@ -23,6 +23,25 @@ exchange, so traffic from a previous incarnation (a rank that missed
 the rebuild) is FENCED: it fails the digest comparison with an
 explicit stale-generation error instead of desynchronizing — let alone
 corrupting — the new ring.
+
+**Arbitrated rendezvous.** Pass ``controller=`` (a coordinator
+address or ``ControlClient``) and a ``world_name`` and the per-rank
+guesswork above is replaced by a single owner of lifecycle state
+(``rocnrdma_tpu.control``): the coordinator names the world, hands
+out the base port and generation, holds member leases renewed by a
+background heartbeat, and arbitrates elastic rejoin — every surviving
+or rejoining rank parks at the coordinator's rendezvous barrier and
+receives the SAME membership view (generation + epoch), so no rank
+ever guesses the next generation locally. The legacy pairwise path
+(no coordinator) is unchanged and test-pinned as the fallback.
+
+**Multi-tenancy.** One Engine may host several concurrent named
+worlds (``qp_budget`` bounds each world's QP appetite at bring-up;
+``Engine.set_qp_limit`` caps the engine natively). Engines shared by
+more than one world run with the engine-wide seal incarnation stamp
+cleared — co-tenant worlds at different generations would fence each
+other's frames — so stale-world protection there degrades to the
+schedule-digest generation check, which is per world.
 """
 
 from __future__ import annotations
@@ -59,21 +78,39 @@ _DG_BYTES = 41
 _GEN_BYTES = 9
 
 
+def rebuild_jitter_seed() -> int:
+    """Base seed for rebuild backoff jitter (TDR_REBUILD_SEED, default
+    0). The jitter rng is seeded per (seed, rank, generation), so a
+    soak failure replays exactly under the same TDR_FAULT_PLAN — the
+    global random module never participates."""
+    try:
+        return int(os.environ.get("TDR_REBUILD_SEED", "0"))
+    except ValueError:
+        return 0
+
+
 class RingWorld:
     def __init__(
         self,
         engine: Engine,
         rank: int,
         world: int,
-        base_port: int,
+        base_port: Optional[int] = None,
         peers: Optional[Sequence[str]] = None,
         bind_host: str = "0.0.0.0",
         timeout_ms: int = 30000,
         generation: int = 0,
         channels: Optional[int] = None,
+        controller=None,
+        world_name: str = "default",
+        qp_budget: Optional[int] = None,
     ):
         if world < 2:
             raise ValueError("RingWorld needs world >= 2")
+        if base_port is None and controller is None:
+            raise ValueError("base_port is required without a "
+                             "controller (arbitrated worlds get their "
+                             "port range from the coordinator)")
         self.engine = engine
         self.rank = rank
         self.world = world
@@ -91,11 +128,25 @@ class RingWorld:
             ring_channels_default()
         if self.channels < 1:
             raise ValueError("channels must be >= 1")
-        # Incarnation number of this ring; monotonic. The bootstrap
-        # exchange adopts the ring maximum, so a restarted rank
-        # (proposing its stale or zero count) catches up with the
-        # survivors' rebuild() bumps.
+        # Incarnation number of this ring; monotonic. Legacy path: the
+        # bootstrap exchange adopts the ring maximum, so a restarted
+        # rank (proposing its stale or zero count) catches up with the
+        # survivors' rebuild() bumps. Arbitrated path: the COORDINATOR
+        # owns this number — every bump is a membership or failure
+        # decision it made, and ranks only ever adopt its view.
         self.generation = int(generation)
+        # Arbitrated-rendezvous state (None controller = legacy path).
+        if isinstance(controller, str):
+            from rocnrdma_tpu.control.client import ControlClient
+
+            controller = ControlClient(controller)
+        self.controller = controller
+        self.world_name = str(world_name)
+        self.qp_budget = None if qp_budget is None else int(qp_budget)
+        self._ctl_inc: Optional[int] = None  # coordinator incarnation
+        self._ctl_epoch = 0                  # membership view counter
+        self._ctl_lease_ms = 5000
+        self._hb = None                      # background lease renewal
         # Per-channel neighbor QPs; left_qp/right_qp alias channel 0
         # (the digest exchange and capability probes ride channel 0).
         self.left_qps: List[QueuePair] = []
@@ -118,14 +169,66 @@ class RingWorld:
         # Last ring-verified schedule digest: steady-state calls with
         # an unchanged digest skip the exchange entirely.
         self._sched_verified: bytes = b""
-        self._bootstrap(timeout_ms)
+        try:
+            self._bootstrap(timeout_ms)
+        except BaseException:
+            # A failed CONSTRUCTION leaves no world behind: detach so
+            # the engine's tenancy count (which gates the seal stamp)
+            # never counts a world the caller never received. rebuild()
+            # failures keep the attachment — that world still exists
+            # and still occupies the engine.
+            self.engine.detach_world(self)
+            raise
 
     # ------------------------------------------------------ bootstrap
+
+    def _listen(self, host: str, port: int, timeout_ms: int) -> QueuePair:
+        """Accept one neighbor connection. EADDRINUSE is a FAST-retry
+        condition, not a failed attempt: when a new incarnation races a
+        lingering listener from the torn-down one (the accept socket
+        sets SO_REUSEADDR natively, so TIME_WAIT never binds-blocks,
+        but a live listener still does), burning a full backoff attempt
+        on it can eat the whole rebuild budget. Retry the bind every
+        50 ms inside this attempt's deadline instead."""
+        deadline = time.monotonic() + max(timeout_ms, 0) / 1000.0
+        while True:
+            left_ms = int(max((deadline - time.monotonic()) * 1000, 1))
+            try:
+                return self.engine.listen(host, port, left_ms)
+            except TransportError as e:
+                if "address already in use" not in str(e).lower():
+                    raise
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+
+    def _connect(self, host: str, port: int, timeout_ms: int) -> QueuePair:
+        """Dial one neighbor (the native layer already retries until
+        the listener is up, bounded by the deadline)."""
+        return self.engine.connect(host, port, timeout_ms)
 
     def _bootstrap(self, timeout_ms: int) -> None:
         """Bring up neighbor QPs + ring and agree on the generation.
         On failure nothing usable is left behind (partial QPs are
         closed); the Engine stays reusable."""
+        self.engine.attach_world(self)
+        arbitrated = self.controller is not None
+        if arbitrated:
+            # The coordinator's rendezvous barrier replaces the
+            # per-rank generation guesswork: every rank of this
+            # incarnation receives the SAME membership view here.
+            self._ctl_rendezvous(timeout_ms)
+        nchan = self.channels
+        # Per-world QP budget, enforced at bring-up: this world needs
+        # 2 * channels QPs (one accept + one dial per channel). An
+        # over-budget world must die HERE, before it consumes a
+        # co-tenant world's native QP headroom or its peer's accept.
+        if self.qp_budget is not None and 2 * nchan > self.qp_budget:
+            raise TransportError(
+                f"world {self.world_name!r} needs {2 * nchan} QPs "
+                f"({nchan} channels) but its qp_budget is "
+                f"{self.qp_budget}; lower TDR_RING_CHANNELS or raise "
+                "the budget", retryable=False)
         rank, world = self.rank, self.world
         right = (rank + 1) % world
         # Drop any seal stamp retained from a previous incarnation
@@ -137,9 +240,12 @@ class RingWorld:
         # exactly the fault regime rebuild() exists to survive. Ghost
         # frames from the old incarnation cannot reach the new QPs
         # (connections are incarnation-scoped), so the fence loses
-        # nothing during the window.
+        # nothing during the window. On an engine hosting MULTIPLE
+        # worlds this also protects the co-tenants: an engine-wide
+        # stamp naming one world's generation would fence the others'
+        # frames, so shared engines run permanently unstamped.
         self.engine.clear_seal_context()
-        nchan = self.channels
+        seal_exclusive = self.engine.world_count <= 1
         accepted: List[Optional[QueuePair]] = [None] * nchan
         err: List[Optional[BaseException]] = [None]
 
@@ -154,7 +260,7 @@ class RingWorld:
                         if self.peers[rank] in ("127.0.0.1", "localhost")
                         else self.bind_host)
                 for c in range(nchan):
-                    accepted[c] = self.engine.listen(
+                    accepted[c] = self._listen(
                         host, self.base_port + rank, timeout_ms)
             except BaseException as e:  # surfaced after join
                 err[0] = e
@@ -164,7 +270,7 @@ class RingWorld:
         dialed: List[QueuePair] = []
         try:
             for c in range(nchan):
-                dialed.append(self.engine.connect(
+                dialed.append(self._connect(
                     self.peers[right], self.base_port + right, timeout_ms))
         except BaseException:
             # The accept side is deadline-bounded; reap whatever it
@@ -190,20 +296,32 @@ class RingWorld:
             self._sched_verified = b""
             self._barrier_buf = None
             self._ensure_digest_bufs()
-            self._exchange_generation(timeout_ms)
-            # Seal context only AFTER the ring agreed on a generation:
-            # during the exchange itself ranks may legitimately hold
-            # different proposals, and a premature stamp would fence
-            # the very frames that reconcile them. From here on, every
-            # outbound seal names this incarnation and stale-world
-            # ghosts fail verification.
-            self.engine.set_seal_context(self.generation, self._seal_step)
+            if not arbitrated:
+                # Legacy pairwise path: circulate the ring-maximum
+                # proposal. Arbitrated worlds already HOLD the
+                # coordinator's generation — exchanging proposals
+                # would reintroduce exactly the rank-local guessing
+                # the coordinator exists to remove (and it saves
+                # world-1 bootstrap hops).
+                self._exchange_generation(timeout_ms)
+            # Seal context only AFTER the generation is agreed (ring
+            # maximum or coordinator view): a premature stamp would
+            # fence the frames that reconcile differing proposals.
+            # From here on, every outbound seal names this incarnation
+            # and stale-world ghosts fail verification — unless the
+            # engine hosts co-tenant worlds, which run unstamped (see
+            # clear_seal_context above).
+            if seal_exclusive and self.engine.world_count <= 1:
+                self.engine.set_seal_context(self.generation,
+                                             self._seal_step)
             self.seal_config = (
                 f"seal={int(bool(self.left_qp.has_seal))}"
                 f":retry={seal_retry_budget()}")
         except BaseException:
             self._teardown()
             raise
+        if arbitrated:
+            self._ensure_heartbeat()
         # tel_engine ties this rank to its native flight-recorder
         # track, so exporters label the engine timeline "rank N";
         # tel_left/tel_right name the per-channel QP lanes (chunk
@@ -213,8 +331,130 @@ class RingWorld:
                     generation=self.generation,
                     tel_engine=self.engine.telemetry_id,
                     channels=self.channels,
+                    world_name=self.world_name,
+                    arbitrated=int(arbitrated),
                     tel_left=[qp.telemetry_id for qp in self.left_qps],
                     tel_right=[qp.telemetry_id for qp in self.right_qps])
+
+    # --------------------------------------------------- control plane
+
+    def _ctl_rendezvous(self, timeout_ms: int) -> None:
+        """Park at the coordinator's rendezvous barrier and adopt its
+        membership view (generation, epoch, base port, peers). A
+        surviving member re-syncs under its existing incarnation; a
+        fresh or superseded member joins for a new one. Raises a
+        retryable TransportError on arbitration refusal (rendezvous
+        timeout, coordinator unreachable) so rebuild()'s attempt loop
+        paces the retry."""
+        from rocnrdma_tpu.control.client import ControlError
+
+        timeout_s = max(1.0, timeout_ms / 1000.0)
+        view = None
+        try:
+            if self._ctl_inc is not None:
+                view = self.controller.sync(self.world_name, self.rank,
+                                            self._ctl_inc,
+                                            timeout_s=timeout_s)
+                if not view.get("ok"):
+                    if view.get("error") != "superseded":
+                        raise TransportError(
+                            f"control sync failed on rank {self.rank}: "
+                            f"{view.get('error')}", retryable=True)
+                    # The coordinator lease-expired (or replaced) this
+                    # incarnation while we were down: rejoin fresh.
+                    self._ctl_inc = None
+                    view = None
+            if view is None:
+                host = (self.peers[self.rank]
+                        if self.peers and 0 <= self.rank < len(self.peers)
+                        else "127.0.0.1")
+                view = self.controller.join(self.world_name, self.world,
+                                            rank=self.rank, host=host,
+                                            timeout_s=timeout_s)
+                if not view.get("ok"):
+                    raise TransportError(
+                        f"control join failed on rank {self.rank}: "
+                        f"{view.get('error')}", retryable=True)
+        except ControlError as e:
+            raise TransportError(str(e), retryable=True) from e
+        # Adopt the coordinator-ASSIGNED ring position: rank=-1 asks
+        # for the lowest free slot, and the whole port/neighbor scheme
+        # below keys off self.rank.
+        self.rank = int(view.get("rank", self.rank))
+        self._ctl_inc = int(view["incarnation"])
+        self.generation = int(view["generation"])
+        self._ctl_epoch = int(view["epoch"])
+        self.base_port = int(view["base_port"])
+        self._ctl_lease_ms = int(view.get("lease_ms", 5000))
+        peers = view.get("peers")
+        if peers:
+            self.peers = [str(p) for p in peers]
+        budget = int(view.get("qp_budget") or 0)
+        if budget:
+            # Coordinator-assigned per-world budget: the stricter of
+            # the two bounds wins.
+            self.qp_budget = (budget if self.qp_budget is None
+                              else min(self.qp_budget, budget))
+        trace.event("ctl.view", rank=self.rank,
+                    world_name=self.world_name,
+                    generation=self.generation, epoch=self._ctl_epoch,
+                    base_port=self.base_port,
+                    incarnation=self._ctl_inc)
+
+    def _ensure_heartbeat(self) -> None:
+        """Start (once) the background lease renewal, pushing native
+        counter/histogram snapshots so the coordinator's /metrics
+        serves this member's chunk latencies and integrity ladder.
+        The thread holds only a WEAK reference to this world: an
+        abandoned (never-closed) world must stay collectable — its
+        engine tenancy entry is a WeakSet — and its lease must AGE OUT
+        at the coordinator (a strong ref would renew a dead
+        incarnation's lease forever and park surviving peers'
+        rendezvous until timeout)."""
+        if self._hb is not None:
+            return
+        import weakref
+
+        wself = weakref.ref(self)
+
+        def _state():
+            w = wself()
+            if w is None:
+                return None  # world collected: heartbeat thread exits
+            return (w._ctl_inc, w.generation)
+
+        def _counters():
+            from rocnrdma_tpu.transport.engine import native_counters
+
+            snap = dict(native_counters())
+            snap.update(trace.counters_prefixed("world."))
+            snap.update(trace.counters_prefixed("ctl."))
+            snap.update(trace.counters_prefixed("trainer."))
+            return snap
+
+        def _hists():
+            from rocnrdma_tpu.transport.engine import \
+                telemetry_histograms
+
+            return {name: {i: c for i, c in enumerate(buckets) if c}
+                    for name, buckets in telemetry_histograms().items()}
+
+        self._hb = self.controller.start_heartbeat(
+            self.world_name, self.rank, state_fn=_state,
+            interval_s=max(0.2, self._ctl_lease_ms / 3000.0),
+            counters_fn=_counters, hists_fn=_hists)
+
+    @property
+    def control_stamp(self) -> str:
+        """Arbitration term for the schedule digest: the coordinator's
+        generation and membership epoch. Empty (legacy digests are
+        preserved byte-for-byte) without a controller; with one, two
+        ranks acting on different membership views fail the first
+        collective's digest exchange instead of desynchronizing."""
+        if self.controller is None:
+            return ""
+        return (f"ctl={self.world_name}:g{self.generation}"
+                f":e{self._ctl_epoch}")
 
     def _ensure_digest_bufs(self) -> None:
         if self._dg_smr is not None:
@@ -245,11 +485,23 @@ class RingWorld:
     # contains, so a training step reads top-down from ring_allreduce
     # to an individual chunk retransmit.
 
+    def _live_ring(self) -> Ring:
+        """The ring, or a RETRYABLE error when this incarnation is
+        torn down (a flapped rank's collectives between teardown and
+        rebuild must surface as elastic-recoverable, not as an
+        AttributeError the trainer cannot classify)."""
+        ring = self.ring
+        if ring is None:
+            raise TransportError(
+                f"world torn down on rank {self.rank} (no live "
+                "incarnation); rebuild() required", retryable=True)
+        return ring
+
     def allreduce(self, array, op: int = RED_SUM) -> None:
         """In-place ring allreduce of a C-contiguous numpy array."""
         with trace.span("world.allreduce", rank=self.rank,
                         bytes=int(array.nbytes)):
-            self.ring.allreduce(array, op)
+            self._live_ring().allreduce(array, op)
 
     def reduce_scatter(self, array, op: int = RED_SUM) -> slice:
         """In-place reduce-scatter; returns the element slice this
@@ -257,21 +509,21 @@ class RingWorld:
         all_gather on the same buffer)."""
         with trace.span("world.reduce_scatter", rank=self.rank,
                         bytes=int(array.nbytes)):
-            return self.ring.reduce_scatter(array, op)
+            return self._live_ring().reduce_scatter(array, op)
 
     def all_gather(self, array) -> None:
         """In-place all-gather of per-rank owned segments (the layout
         ``reduce_scatter`` leaves)."""
         with trace.span("world.all_gather", rank=self.rank,
                         bytes=int(array.nbytes)):
-            self.ring.all_gather(array)
+            self._live_ring().all_gather(array)
 
     def broadcast(self, array, root: int = 0) -> None:
         """Broadcast root's buffer to every rank (store-and-forward
         chunk pipeline down the ring)."""
         with trace.span("world.broadcast", rank=self.rank,
                         bytes=int(array.nbytes)):
-            self.ring.broadcast(array, root)
+            self._live_ring().broadcast(array, root)
 
     def all_to_all(self, array) -> None:
         """In-place all-to-all: the flat buffer is ``world`` equal
@@ -280,7 +532,7 @@ class RingWorld:
         collectives/ulysses.py)."""
         with trace.span("world.all_to_all", rank=self.rank,
                         bytes=int(array.nbytes)):
-            self.ring.all_to_all(array)
+            self._live_ring().all_to_all(array)
 
     def reduce(self, array, root: int = 0, op: int = RED_SUM) -> None:
         """Root-reduce: root's buffer ends holding the reduction over
@@ -289,15 +541,19 @@ class RingWorld:
         the result intact)."""
         with trace.span("world.reduce", rank=self.rank,
                         bytes=int(array.nbytes)):
-            self.ring.reduce(array, root, op)
+            self._live_ring().reduce(array, root, op)
 
     def set_seal_step(self, step: int) -> None:
         """Stamp the training step into outbound seals (informational
         but CRC-covered: a corrupted tag fails verification like a
         corrupted payload). The sync layer forwards the elastic
-        trainer's step token here."""
+        trainer's step token here. On an engine shared by several
+        worlds the engine-wide stamp stays CLEARED (a restamp here
+        would fence the co-tenant worlds' frames with THIS world's
+        generation — see the bootstrap's multi-tenancy note)."""
         self._seal_step = int(step)
-        self.engine.set_seal_context(self.generation, self._seal_step)
+        if self.engine.world_count <= 1:
+            self.engine.set_seal_context(self.generation, self._seal_step)
 
     def barrier(self) -> None:
         """Collective barrier: no rank returns before every rank has
@@ -308,14 +564,15 @@ class RingWorld:
         created and ring-registered once, so steady-state barriers
         post work requests only (the front-loaded-registration
         invariant)."""
+        ring = self._live_ring()
         buf = self._barrier_buf
         if buf is None:
             buf = self._barrier_buf = np.zeros(self.world,
                                                dtype=np.int32)
-            self.ring.register_buffer(buf)
+            ring.register_buffer(buf)
         else:
             buf[:] = 0
-        self.ring.allreduce(buf)
+        ring.allreduce(buf)
 
     def _dg_hop(self, send_len: int, timeout: int, what: str) -> None:
         """One neighbor hop of the digest protocol: recv ``send_len``
@@ -382,6 +639,7 @@ class RingWorld:
         if digest == self._sched_verified:
             trace.event("world.sched_cached")
             return
+        self._live_ring()  # torn-down incarnation -> retryable, early
         self._ensure_digest_bufs()
         assert len(digest) == 32
         timeout = int(os.environ.get("TDR_RING_TIMEOUT_MS", "30000"))
@@ -469,7 +727,8 @@ class RingWorld:
 
     def rebuild(self, max_attempts: int = 6, backoff_s: float = 0.2,
                 backoff_cap_s: float = 5.0, jitter: float = 0.25,
-                timeout_ms: Optional[int] = None) -> "RingWorld":
+                timeout_ms: Optional[int] = None,
+                jitter_seed: Optional[int] = None) -> "RingWorld":
         """Tear down this incarnation and re-rendezvous under the next
         generation: exponential backoff with jitter between attempts,
         a bounded retry budget, and a per-attempt accept/connect
@@ -477,17 +736,39 @@ class RingWorld:
         rebuild (survivors call this; a restarted rank constructs a
         fresh ``RingWorld`` at the same ports and adopts the bumped
         generation at bootstrap). Raises a non-retryable
-        ``TransportError`` when the budget is exhausted."""
+        ``TransportError`` when the budget is exhausted.
+
+        **Legacy path** (no controller): this rank bumps its own
+        generation proposal; the bootstrap exchange circulates the
+        ring maximum. **Arbitrated path**: the failure is REPORTED to
+        the coordinator — the first report of an incident moves the
+        world's generation, every later one just learns it — and each
+        bootstrap attempt parks at the coordinator's rendezvous
+        barrier, adopting whatever membership view it releases. No
+        rank-local generation arithmetic happens at all.
+
+        Backoff jitter is drawn from a ``random.Random`` seeded with
+        (``jitter_seed`` or TDR_REBUILD_SEED, rank, generation) —
+        never the global ``random`` module — so a soak failure
+        replays exactly under the same ``TDR_FAULT_PLAN``."""
         timeout = int(self.timeout_ms if timeout_ms is None else timeout_ms)
         note_fault_injections()
         note_integrity()
         self._teardown()
-        self.generation += 1
+        arbitrated = self.controller is not None
+        if arbitrated:
+            self._ctl_report_failure()
+        else:
+            self.generation += 1
         trace.event("world.rebuild", rank=self.rank, phase="begin",
-                    generation=self.generation)
-        # Deterministic per-(rank, generation) jitter: desynchronizes
-        # ranks' retry storms without making test runs flaky.
-        rng = random.Random((self.rank << 20) ^ self.generation)
+                    generation=self.generation,
+                    arbitrated=int(arbitrated))
+        # Deterministic per-(seed, rank, generation) jitter:
+        # desynchronizes ranks' retry storms without making fault-plan
+        # replays flaky (string seeding is stable across processes —
+        # no PYTHONHASHSEED dependence).
+        seed = rebuild_jitter_seed() if jitter_seed is None else jitter_seed
+        rng = random.Random(f"{seed}:{self.rank}:{self.generation}")
         delay = float(backoff_s)
         last: Optional[BaseException] = None
         for attempt in range(1, max_attempts + 1):
@@ -497,6 +778,11 @@ class RingWorld:
                 note_integrity()
                 trace.event("world.rebuild", rank=self.rank, phase="ok",
                             generation=self.generation, attempts=attempt)
+                if arbitrated:
+                    trace.event("ctl.rebuild", rank=self.rank,
+                                world_name=self.world_name,
+                                generation=self.generation,
+                                epoch=self._ctl_epoch, attempts=attempt)
                 return self
             except (TransportError, TimeoutError, OSError) as e:
                 last = e
@@ -514,7 +800,40 @@ class RingWorld:
             f"{self.rank}, generation {self.generation}): {last}",
             retryable=False)
 
+    def _ctl_report_failure(self) -> None:
+        """Tell the coordinator this incarnation failed. Best-effort:
+        if the coordinator is briefly unreachable, the rendezvous in
+        the next bootstrap attempt still adopts whatever view it
+        releases (a peer's report, or a lease expiry, moves the
+        generation without us)."""
+        from rocnrdma_tpu.control.client import ControlError
+
+        try:
+            resp = self.controller.report(
+                self.world_name, self.rank, self._ctl_inc or 0,
+                self.generation, error="retryable transport failure")
+            trace.event("ctl.report", rank=self.rank,
+                        world_name=self.world_name,
+                        generation=int(resp.get("generation",
+                                                self.generation)))
+        except ControlError:
+            trace.event("ctl.report_unreachable", rank=self.rank,
+                        world_name=self.world_name)
+
     def close(self) -> None:
+        if self._hb is not None:
+            hb, self._hb = self._hb, None
+            try:
+                hb.stop(flush=True)
+            except Exception:
+                pass
+        if self.controller is not None and self._ctl_inc is not None:
+            try:
+                self.controller.leave(self.world_name, self.rank,
+                                      self._ctl_inc)
+            except Exception:
+                pass
+            self._ctl_inc = None
         self._teardown()
         for mr in (self._dg_smr, self._dg_rmr):
             if mr is not None:
@@ -523,6 +842,7 @@ class RingWorld:
                 except Exception:
                     pass
         self._dg_smr = self._dg_rmr = None
+        self.engine.detach_world(self)
 
     def __enter__(self):
         return self
@@ -531,17 +851,22 @@ class RingWorld:
         self.close()
 
 
-def local_worlds(n: int, base_port: int, spec: str = "emu"
-                 ) -> List[RingWorld]:
+def local_worlds(n: int, base_port: Optional[int] = None,
+                 spec: str = "emu", engines: Optional[List[Engine]] = None,
+                 **kwargs) -> List[RingWorld]:
     """Bring up an n-rank ring fully in-process (one Engine per rank,
-    one thread per rank during bootstrap) — the test/bench topology."""
-    engines = [Engine(spec) for _ in range(n)]
+    one thread per rank during bootstrap) — the test/bench topology.
+    ``engines`` reuses caller-owned engines (concurrent-world tests
+    share one engine set across several named worlds); ``kwargs``
+    forward to RingWorld (controller=, world_name=, channels=, ...)."""
+    engines = engines if engines is not None else \
+        [Engine(spec) for _ in range(n)]
     out: List[Optional[RingWorld]] = [None] * n
     errs: List[Optional[BaseException]] = [None] * n
 
     def boot(r: int):
         try:
-            out[r] = RingWorld(engines[r], r, n, base_port)
+            out[r] = RingWorld(engines[r], r, n, base_port, **kwargs)
         except BaseException as e:
             errs[r] = e
 

@@ -1,0 +1,12 @@
+"""Control plane: named rendezvous with leases and arbitrated rejoin.
+
+``coordinator`` is the single-process rendezvous/coordination service
+(runnable via ``tools/tdr_rendezvous.py``); ``client`` is the member
+side RingWorld embeds. The legacy pairwise bootstrap keeps working
+with no coordinator — this package is the arbitrated upgrade path.
+"""
+
+from rocnrdma_tpu.control.client import ControlClient, ControlError
+from rocnrdma_tpu.control.coordinator import Coordinator
+
+__all__ = ["Coordinator", "ControlClient", "ControlError"]

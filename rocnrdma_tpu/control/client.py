@@ -1,0 +1,202 @@
+"""Member-side control-plane client (stdlib-only).
+
+One request per connection (the coordinator closes after answering),
+so a parked rendezvous call never blocks heartbeats — the background
+``Heartbeat`` thread opens its own connections. All methods return the
+coordinator's response dict; ``ok`` is False on arbitration refusals
+(stale incarnation, rendezvous timeout) — the member decides whether
+that means re-join or give up. Transport-level failures raise
+``ControlError``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from rocnrdma_tpu.utils.trace import trace
+
+
+class ControlError(RuntimeError):
+    """The coordinator was unreachable or spoke garbage (distinct from
+    an ok=False arbitration answer, which is a protocol-level verdict
+    the member must interpret)."""
+
+
+class ControlClient:
+    def __init__(self, address: str, timeout_s: float = 120.0):
+        host, _, port = address.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"control address must be host:port, "
+                             f"got {address!r}")
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ------------------------------------------------------------ wire
+
+    def request(self, op: str, timeout_s: Optional[float] = None,
+                **fields: Any) -> Dict[str, Any]:
+        budget = self.timeout_s if timeout_s is None else float(timeout_s)
+        # The budget rides IN the payload: the coordinator parks
+        # join/sync for the CALLER's budget, not its own default —
+        # otherwise an aborted-and-retried sync leaves an orphaned
+        # handler parked on the same member for the server default,
+        # racing the retry for the released view.
+        req = dict(fields, op=op, timeout_s=budget)
+        try:
+            with socket.create_connection(
+                    (self.host, self.port), timeout=budget + 10.0) as s:
+                f = s.makefile("rwb")
+                f.write((json.dumps(req) + "\n").encode())
+                f.flush()
+                line = f.readline()
+            if not line:
+                raise ControlError(
+                    f"coordinator {self.address} closed the connection")
+            return json.loads(line.decode())
+        except (OSError, ValueError) as e:
+            raise ControlError(
+                f"coordinator {self.address} unreachable for "
+                f"{op}: {e}") from e
+
+    # ------------------------------------------------------ operations
+
+    def join(self, world: str, size: int, rank: int = -1,
+             host: str = "127.0.0.1",
+             timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        budget = self.timeout_s if timeout_s is None else float(timeout_s)
+        return self.request("join", timeout_s=budget, world=world,
+                            size=int(size), rank=int(rank), host=host)
+
+    def sync(self, world: str, rank: int, incarnation: int,
+             timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        budget = self.timeout_s if timeout_s is None else float(timeout_s)
+        return self.request("sync", timeout_s=budget, world=world,
+                            rank=int(rank), incarnation=int(incarnation))
+
+    def report(self, world: str, rank: int, incarnation: int,
+               generation: int, error: str = "") -> Dict[str, Any]:
+        return self.request("report", world=world, rank=int(rank),
+                            incarnation=int(incarnation),
+                            generation=int(generation),
+                            error=str(error)[:400])
+
+    def heartbeat(self, world: str, rank: int, incarnation: int,
+                  generation: int,
+                  counters: Optional[Dict[str, int]] = None,
+                  hists: Optional[Dict[str, Dict[int, int]]] = None
+                  ) -> Dict[str, Any]:
+        return self.request("heartbeat", timeout_s=15.0, world=world,
+                            rank=int(rank), incarnation=int(incarnation),
+                            generation=int(generation),
+                            counters=counters, hists=hists)
+
+    def leave(self, world: str, rank: int,
+              incarnation: int) -> Dict[str, Any]:
+        return self.request("leave", timeout_s=15.0, world=world,
+                            rank=int(rank), incarnation=int(incarnation))
+
+    def metrics(self) -> str:
+        """Scrape the coordinator's /metrics endpoint (the same HTTP
+        text a Prometheus scraper would read)."""
+        with socket.create_connection((self.host, self.port),
+                                      timeout=15.0) as s:
+            s.sendall(b"GET /metrics HTTP/1.0\r\n\r\n")
+            chunks = []
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                chunks.append(b)
+        blob = b"".join(chunks)
+        head, _, body = blob.partition(b"\r\n\r\n")
+        if not head.startswith(b"HTTP/1.0 200"):
+            raise ControlError(
+                f"/metrics scrape failed: {head.splitlines()[:1]}")
+        return body.decode()
+
+    # ------------------------------------------------------- heartbeat
+
+    def start_heartbeat(self, world: str, rank: int,
+                        state_fn: Callable[[], tuple],
+                        interval_s: float,
+                        counters_fn: Optional[Callable[[], Dict]] = None,
+                        hists_fn: Optional[Callable[[], Dict]] = None
+                        ) -> "Heartbeat":
+        """Renew this member's lease from a daemon thread every
+        ``interval_s``, pushing counter/histogram snapshots for the
+        coordinator's /metrics aggregation. ``state_fn`` returns the
+        member's CURRENT (incarnation, generation) — it changes across
+        rejoins, so the thread reads it per beat."""
+        return Heartbeat(self, world, rank, state_fn, interval_s,
+                         counters_fn, hists_fn)
+
+
+class Heartbeat:
+    def __init__(self, client: ControlClient, world: str, rank: int,
+                 state_fn: Callable[[], tuple], interval_s: float,
+                 counters_fn: Optional[Callable[[], Dict]] = None,
+                 hists_fn: Optional[Callable[[], Dict]] = None):
+        self._client = client
+        self._world = world
+        self._rank = rank
+        self._state_fn = state_fn
+        self._interval = max(0.05, float(interval_s))
+        self._counters_fn = counters_fn
+        self._hists_fn = hists_fn
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"tdr-ctl-hb-{world}-{rank}")
+        self._thread.start()
+
+    def beat(self) -> bool:
+        """One synchronous beat (also used as the final flush before
+        leave, so /metrics reflects the member's last snapshots).
+        Returns False when ``state_fn`` reports the member object is
+        GONE (garbage-collected) — the thread must exit and the lease
+        age out at the coordinator."""
+        state = self._state_fn()
+        if state is None:
+            return False
+        inc, gen = state
+        if inc is None:
+            return True  # between incarnations: nothing to renew
+        counters = self._counters_fn() if self._counters_fn else None
+        hists = self._hists_fn() if self._hists_fn else None
+        resp = self._client.heartbeat(self._world, self._rank, inc, gen,
+                                      counters=counters, hists=hists)
+        if not resp.get("ok"):
+            trace.event("ctl.heartbeat_refused", world=self._world,
+                        rank=self._rank,
+                        error=str(resp.get("error", ""))[:80])
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                if not self.beat():
+                    return  # member collected: stop renewing its lease
+            except ControlError:
+                # The coordinator being briefly unreachable must never
+                # take the member down; the lease ages, and the member
+                # rejoins through the normal arbitration path if it
+                # expires meanwhile.
+                pass
+            except Exception:
+                pass  # diagnostics must never kill the workload
+
+    def stop(self, flush: bool = False) -> None:
+        self._stop.set()
+        if flush:
+            try:
+                self.beat()
+            except Exception:
+                pass
+        self._thread.join(timeout=5)

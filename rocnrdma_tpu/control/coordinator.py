@@ -1,0 +1,549 @@
+"""Rendezvous coordinator: named worlds, leases, arbitrated rejoin.
+
+A small single-process TCP service (stdlib-only) that owns the
+lifecycle state the pairwise bootstrap used to infer per rank: it
+names worlds, hands out ring positions, base ports, and generation
+numbers, and arbitrates elastic rejoin. "The DMA Streaming Framework"
+discipline applied to membership: ONE owner of lifecycle state instead
+of N peers independently guessing the next generation.
+
+**Model.** A *world* is a named, multi-tenant resource: fixed size,
+a base port carved from the coordinator's port pool (so two jobs
+sharing a NIC never fight for listen ports), a monotonic generation,
+and one member slot per ring position. Members hold *leases* renewed
+by heartbeats; a member that misses its lease is declared dead by the
+coordinator — never by a peer's guess — which bumps the generation.
+Generation bumps happen in exactly three places, all here: a lease
+expiry, a membership change (rejoin/supersede/leave) after the world
+first became ready, and a member's failure report. Ranks NEVER bump
+locally on the arbitrated path.
+
+**Rendezvous barrier.** ``join`` (new/restarted member) and ``sync``
+(surviving member re-rendezvousing during rebuild) both park the
+caller at the world's barrier. When every slot is filled by a live
+member and all of them are parked, the coordinator atomically builds
+ONE membership view — generation, epoch, base port, peer hosts — and
+answers every parked member with it. Two ranks can therefore never
+act on different views of the same incarnation; the epoch is the view
+counter and is stamped (with the generation) into the schedule digest
+by the member side.
+
+**Wire protocol.** One JSON object per line, one request per
+connection; the response is one JSON line. The same port also answers
+``GET /metrics`` (and ``GET /healthz``) with a Prometheus-style text
+exposition: coordinator state (generation, members, rebuilds, lease
+expiries) plus the member-pushed native counter registry and log2
+histograms (heartbeats carry snapshots), rendered as ``tdr_*`` series
+with per-world labels — chunk p99, retransmit rate, NAK count, and
+rebuild count become scrapeable SLOs.
+
+In-process caveat: multi-rank test harnesses run many members in one
+process, which share one process-wide native registry — summed
+counter series over-count by the member multiplier there. Production
+members are one process each, where the sum is exact.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from rocnrdma_tpu.telemetry.recorder import hist_percentile
+from rocnrdma_tpu.utils.trace import trace
+
+# Histograms surfaced as quantile series on /metrics (names match the
+# native recorder's tdr_tel_hist_name table).
+_QUANTILES = (50, 90, 99)
+
+# Protocol / metrics contract version (served on /metrics so scrapers
+# can pin the names they parse).
+PROTOCOL_VERSION = 1
+
+
+class _Member:
+    __slots__ = ("rank", "incarnation", "host", "lease_deadline", "alive",
+                 "waiting", "pending_view", "counters", "hists",
+                 "wait_token")
+
+    def __init__(self, rank: int, incarnation: int, host: str,
+                 lease_deadline: float):
+        self.rank = rank
+        self.incarnation = incarnation
+        self.host = host
+        self.lease_deadline = lease_deadline
+        self.alive = True
+        self.waiting = False
+        self.pending_view: Optional[Dict[str, Any]] = None
+        self.counters: Dict[str, int] = {}
+        self.hists: Dict[str, Dict[int, int]] = {}
+        # Park token: a re-issued sync for this member bumps it, so an
+        # ORPHANED handler (client gave up and retried; its connection
+        # is dead) stops waiting instead of racing the live retry for
+        # the released view.
+        self.wait_token = 0
+
+
+class _World:
+    __slots__ = ("name", "size", "base_port", "qp_budget", "generation",
+                 "epoch", "members", "ever_ready", "rebuilds",
+                 "lease_expiries", "joins")
+
+    def __init__(self, name: str, size: int, base_port: int,
+                 qp_budget: int):
+        self.name = name
+        self.size = size
+        self.base_port = base_port
+        self.qp_budget = qp_budget
+        self.generation = 0
+        self.epoch = 0  # view counter: bumps once per barrier release
+        self.members: Dict[int, _Member] = {}
+        self.ever_ready = False
+        self.rebuilds = 0
+        self.lease_expiries = 0
+        self.joins = 0
+
+    def alive_members(self) -> List[_Member]:
+        return [m for m in self.members.values() if m.alive]
+
+
+class Coordinator:
+    """The rendezvous service. ``start()`` binds and serves from
+    daemon threads; ``stop()`` tears down. Thread-per-connection —
+    parked rendezvous calls hold their connection, everything else is
+    one short request."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 lease_ms: int = 5000, port_base: int = 36000,
+                 port_stride: int = 64, qp_budget: int = 0):
+        self.host = host
+        self.lease_ms = int(lease_ms)
+        self.port_base = int(port_base)
+        self.port_stride = int(port_stride)
+        self.qp_budget = int(qp_budget)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._worlds: Dict[str, _World] = {}
+        self._next_inc = itertools.count(1)
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------- lifecycle
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "Coordinator":
+        for target, name in ((self._serve, "tdr-ctl-accept"),
+                             (self._sweep, "tdr-ctl-sweeper")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        trace.event("ctl.coordinator", address=self.address,
+                    lease_ms=self.lease_ms)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._cv:
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # --------------------------------------------------------- serving
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True, name="tdr-ctl-conn")
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(300)
+            f = conn.makefile("rwb")
+            line = f.readline()
+            if not line:
+                return
+            if line.startswith(b"GET "):
+                self._handle_http(f, line)
+                return
+            try:
+                req = json.loads(line.decode())
+                resp = self._dispatch(req)
+            except Exception as e:  # malformed request must not kill us
+                resp = {"ok": False, "error": f"bad request: {e}"}
+            f.write((json.dumps(resp) + "\n").encode())
+            f.flush()
+        except (OSError, ValueError):
+            pass  # client went away; its member state ages out by lease
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_http(self, f, request_line: bytes) -> None:
+        path = request_line.split()[1].decode() if len(
+            request_line.split()) > 1 else "/"
+        while True:  # drain headers
+            h = f.readline()
+            if not h or h in (b"\r\n", b"\n"):
+                break
+        if path.startswith("/metrics"):
+            body = self.render_metrics().encode()
+            status = "200 OK"
+        elif path.startswith("/healthz"):
+            body = b"ok\n"
+            status = "200 OK"
+        else:
+            body = b"not found\n"
+            status = "404 Not Found"
+        f.write((f"HTTP/1.0 {status}\r\n"
+                 "Content-Type: text/plain; version=0.0.4; "
+                 "charset=utf-8\r\n"
+                 f"Content-Length: {len(body)}\r\n"
+                 "Connection: close\r\n\r\n").encode())
+        f.write(body)
+        f.flush()
+
+    def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        handler = {
+            "join": self._op_join,
+            "sync": self._op_sync,
+            "report": self._op_report,
+            "heartbeat": self._op_heartbeat,
+            "leave": self._op_leave,
+        }.get(op)
+        if handler is None:
+            return {"ok": False, "error": f"unknown op: {op}"}
+        return handler(req)
+
+    # ----------------------------------------------------- arbitration
+
+    def _get_world(self, name: str, size: int) -> _World:
+        w = self._worlds.get(name)
+        if w is None:
+            base = self.port_base + len(self._worlds) * self.port_stride
+            w = _World(name, size, base, self.qp_budget)
+            self._worlds[name] = w
+            trace.event("ctl.world", world=name, size=size, base_port=base)
+        return w
+
+    def _membership_changed(self, w: _World, why: str) -> None:
+        """A slot's occupancy changed. Before the world ever became
+        ready this is just the initial fill; afterwards it is a
+        membership decision and bumps the generation (the ONLY place
+        generations move besides failure reports)."""
+        if w.ever_ready:
+            w.generation += 1
+            trace.event("ctl.generation", world=w.name,
+                        generation=w.generation, why=why)
+
+    def _maybe_release(self, w: _World) -> None:
+        """Release the rendezvous barrier: every slot filled by a live
+        member and all of them parked -> build ONE view and hand it to
+        every one of them atomically (under the lock), so no two
+        members can ever act on different views."""
+        alive = w.alive_members()
+        if len(alive) != w.size or not all(m.waiting for m in alive):
+            return
+        w.epoch += 1
+        if w.ever_ready:
+            # Every re-release after the world first became ready IS a
+            # completed rebuild — the SLO counts finished recoveries,
+            # whatever initiated them (failure report, lease expiry,
+            # supersede). Reports only move the generation.
+            w.rebuilds += 1
+        w.ever_ready = True
+        now = time.monotonic()
+        view = {
+            "ok": True,
+            "generation": w.generation,
+            "epoch": w.epoch,
+            "base_port": w.base_port,
+            "world_size": w.size,
+            "lease_ms": self.lease_ms,
+            "qp_budget": w.qp_budget,
+            "peers": [w.members[r].host for r in range(w.size)],
+        }
+        for m in alive:
+            m.waiting = False
+            m.pending_view = dict(view, rank=m.rank,
+                                  incarnation=m.incarnation)
+            m.lease_deadline = now + self.lease_ms / 1000.0
+        trace.event("ctl.release", world=w.name, generation=w.generation,
+                    epoch=w.epoch)
+        self._cv.notify_all()
+
+    def _await_view(self, w: _World, m: _Member,
+                    timeout_s: float) -> Dict[str, Any]:
+        token = m.wait_token
+        deadline = time.monotonic() + timeout_s
+        while m.pending_view is None:
+            if not m.alive:
+                return {"ok": False, "error": "superseded",
+                        "generation": w.generation}
+            if m.wait_token != token:
+                # A newer sync for this member took over the park;
+                # this handler's client is gone. Don't touch
+                # waiting/pending_view — they belong to the newcomer.
+                return {"ok": False, "error": "superseded wait",
+                        "generation": w.generation}
+            left = deadline - time.monotonic()
+            if left <= 0:
+                m.waiting = False
+                return {"ok": False, "error": "rendezvous timeout",
+                        "generation": w.generation}
+            self._cv.wait(min(left, 0.25))
+        if m.wait_token != token:
+            return {"ok": False, "error": "superseded wait",
+                    "generation": w.generation}
+        view, m.pending_view = m.pending_view, None
+        return view
+
+    def _member(self, req: Dict[str, Any]):
+        """Resolve (world, member) for ops that address an existing
+        incarnation; returns (None, error_resp) when stale."""
+        w = self._worlds.get(req.get("world"))
+        if w is None:
+            return None, {"ok": False, "error": "unknown world"}
+        m = w.members.get(int(req.get("rank", -1)))
+        if m is None or not m.alive or \
+                m.incarnation != int(req.get("incarnation", -1)):
+            return None, {"ok": False, "error": "superseded",
+                          "generation": w.generation}
+        return (w, m), None
+
+    # -------------------------------------------------------- handlers
+
+    def _op_join(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        name = str(req["world"])
+        size = int(req["size"])
+        rank = int(req.get("rank", -1))
+        host = str(req.get("host", "127.0.0.1"))
+        timeout_s = min(max(float(req.get("timeout_s", 60.0)), 0.0), 600.0)
+        if size < 2:
+            return {"ok": False, "error": "world size must be >= 2"}
+        with self._cv:
+            w = self._get_world(name, size)
+            if size != w.size:
+                return {"ok": False,
+                        "error": f"world {name} has size {w.size}, "
+                                 f"not {size}"}
+            if rank < 0:
+                free = [r for r in range(w.size)
+                        if r not in w.members or not w.members[r].alive]
+                if not free:
+                    return {"ok": False, "error": "world full"}
+                rank = free[0]
+            if rank >= w.size:
+                return {"ok": False,
+                        "error": f"rank {rank} out of range for size "
+                                 f"{w.size}"}
+            prev = w.members.get(rank)
+            if prev is not None and prev.alive:
+                # A restarted rank racing its own lingering lease: the
+                # NEW incarnation supersedes — the old one is dead by
+                # definition (one process per slot).
+                prev.alive = False
+                self._membership_changed(w, "superseded")
+            elif w.ever_ready:
+                self._membership_changed(w, "rejoin")
+            m = _Member(rank, next(self._next_inc), host,
+                        time.monotonic() + self.lease_ms / 1000.0)
+            m.waiting = True
+            w.members[rank] = m
+            w.joins += 1
+            trace.event("ctl.join", world=name, rank=rank,
+                        incarnation=m.incarnation,
+                        generation=w.generation)
+            self._maybe_release(w)
+            return self._await_view(w, m, timeout_s)
+
+    def _op_sync(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        timeout_s = min(max(float(req.get("timeout_s", 60.0)), 0.0), 600.0)
+        with self._cv:
+            resolved, err = self._member(req)
+            if err:
+                return err
+            w, m = resolved
+            m.lease_deadline = time.monotonic() + self.lease_ms / 1000.0
+            m.wait_token += 1  # supersede any orphaned park (see above)
+            m.waiting = True
+            m.pending_view = None
+            trace.event("ctl.sync", world=w.name, rank=m.rank,
+                        generation=w.generation)
+            self._maybe_release(w)
+            return self._await_view(w, m, timeout_s)
+
+    def _op_report(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._cv:
+            resolved, err = self._member(req)
+            if err:
+                return err
+            w, m = resolved
+            # Idempotent per incident: the bump is keyed on the
+            # reporter's believed generation — the FIRST report of an
+            # incident moves the world forward; later reporters (same
+            # incident, same believed generation, now stale) just
+            # learn the new generation.
+            if int(req.get("generation", -1)) == w.generation:
+                w.generation += 1
+                trace.event("ctl.report", world=w.name, rank=m.rank,
+                            generation=w.generation,
+                            error=str(req.get("error", ""))[:120])
+                self._cv.notify_all()
+            return {"ok": True, "generation": w.generation,
+                    "rebuilds": w.rebuilds}
+
+    def _op_heartbeat(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._cv:
+            resolved, err = self._member(req)
+            if err:
+                return err
+            w, m = resolved
+            m.lease_deadline = time.monotonic() + self.lease_ms / 1000.0
+            counters = req.get("counters")
+            if isinstance(counters, dict):
+                m.counters = {str(k): int(v) for k, v in counters.items()}
+            hists = req.get("hists")
+            if isinstance(hists, dict):
+                m.hists = {
+                    str(name): {int(b): int(c) for b, c in buckets.items()}
+                    for name, buckets in hists.items()
+                    if isinstance(buckets, dict)
+                }
+            return {"ok": True, "generation": w.generation,
+                    "stale": int(req.get("generation", -1)) != w.generation}
+
+    def _op_leave(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._cv:
+            resolved, err = self._member(req)
+            if err:
+                return err
+            w, m = resolved
+            m.alive = False
+            trace.event("ctl.leave", world=w.name, rank=m.rank)
+            self._membership_changed(w, "leave")
+            self._cv.notify_all()
+            return {"ok": True, "generation": w.generation}
+
+    # ---------------------------------------------------------- leases
+
+    def _sweep(self) -> None:
+        interval = max(0.05, self.lease_ms / 4000.0)
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            with self._cv:
+                for w in self._worlds.values():
+                    for m in w.alive_members():
+                        # A parked member IS live: its rendezvous
+                        # connection is open, and during initial join
+                        # its heartbeat thread has not started yet.
+                        if m.waiting or m.lease_deadline > now:
+                            continue
+                        m.alive = False
+                        w.lease_expiries += 1
+                        trace.event("ctl.lease_expired", world=w.name,
+                                    rank=m.rank,
+                                    incarnation=m.incarnation)
+                        self._membership_changed(w, "lease")
+                        self._cv.notify_all()
+
+    # --------------------------------------------------------- metrics
+
+    @staticmethod
+    def _metric_name(counter: str) -> str:
+        safe = "".join(c if c.isalnum() else "_" for c in counter)
+        return f"tdr_{safe}_total"
+
+    def render_metrics(self) -> str:
+        """The Prometheus-style text exposition. Contract-pinned names
+        (tests/test_control.py): ``tdr_ctl_generation``,
+        ``tdr_ctl_members``, ``tdr_ctl_rebuilds_total``,
+        ``tdr_ctl_lease_expiries_total``, ``tdr_retransmit_rate``, the
+        ``tdr_<registry counter>_total`` family (dots -> underscores,
+        e.g. ``tdr_integrity_retransmitted_total``), and the histogram
+        quantile series ``tdr_<hist>{...,quantile="0.99"}`` (e.g.
+        ``tdr_chunk_lat_us``)."""
+        with self._lock:
+            lines = [
+                f"# tdr coordinator metrics v{PROTOCOL_VERSION}",
+                "# TYPE tdr_ctl_worlds gauge",
+                f"tdr_ctl_worlds {len(self._worlds)}",
+            ]
+            lines.append("# TYPE tdr_ctl_generation gauge")
+            lines.append("# TYPE tdr_ctl_members gauge")
+            lines.append("# TYPE tdr_ctl_rebuilds_total counter")
+            lines.append("# TYPE tdr_ctl_lease_expiries_total counter")
+            for name in sorted(self._worlds):
+                w = self._worlds[name]
+                lab = f'{{world="{name}"}}'
+                lines += [
+                    f"tdr_ctl_generation{lab} {w.generation}",
+                    f"tdr_ctl_epoch{lab} {w.epoch}",
+                    f"tdr_ctl_size{lab} {w.size}",
+                    f"tdr_ctl_members{lab} {len(w.alive_members())}",
+                    f"tdr_ctl_base_port{lab} {w.base_port}",
+                    f"tdr_ctl_rebuilds_total{lab} {w.rebuilds}",
+                    f"tdr_ctl_lease_expiries_total{lab} "
+                    f"{w.lease_expiries}",
+                    f"tdr_ctl_joins_total{lab} {w.joins}",
+                ]
+                # Member-pushed counter registry, summed over each
+                # slot's CURRENT occupant — dead or departed members
+                # keep serving their last snapshot (a scraper must not
+                # see the world's history vanish because a rank died;
+                # exact when members are one process each).
+                agg: Dict[str, int] = {}
+                hists: Dict[str, List[int]] = {}
+                for m in w.members.values():
+                    for k, v in m.counters.items():
+                        agg[k] = agg.get(k, 0) + v
+                    for hname, buckets in m.hists.items():
+                        row = hists.setdefault(hname, [0] * 64)
+                        for b, c in buckets.items():
+                            if 0 <= b < 64:
+                                row[b] += c
+                for k in sorted(agg):
+                    lines.append(f"{self._metric_name(k)}{lab} {agg[k]}")
+                sealed = agg.get("integrity.sealed", 0)
+                retx = agg.get("integrity.retransmitted", 0)
+                rate = (retx / sealed) if sealed else 0.0
+                lines.append(f"tdr_retransmit_rate{lab} {rate:.6g}")
+                for hname in sorted(hists):
+                    safe = "".join(c if c.isalnum() else "_"
+                                   for c in hname)
+                    for q in _QUANTILES:
+                        v = hist_percentile(hists[hname], q)
+                        lines.append(
+                            f'tdr_{safe}{{world="{name}",'
+                            f'quantile="0.{q}"}} {v}')
+                    lines.append(
+                        f"tdr_{safe}_count{lab} {sum(hists[hname])}")
+            return "\n".join(lines) + "\n"

@@ -288,6 +288,22 @@ void tdr_engine_close(tdr_engine *e);
 int tdr_engine_kind(const tdr_engine *e);
 const char *tdr_engine_name(const tdr_engine *e);
 
+/* ------------------------------------------------------------------ *
+ * Per-engine QP accounting — multi-tenant engines (one engine hosting
+ * several concurrent named worlds) get a hard cap on live QPs, checked
+ * at bring-up: when the limit is set (> 0; 0 = unlimited) and reached,
+ * tdr_listen/tdr_connect fail fast with a budget error BEFORE touching
+ * the network, so an over-budget world dies at bring-up instead of
+ * starving a co-tenant world of connections mid-soak. Accounting is
+ * backend-independent (enforced at the C API boundary); the count
+ * covers every live QP on the engine regardless of which world owns
+ * it. Budget errors are non-retryable: rebuilding cannot create QP
+ * headroom.
+ * ------------------------------------------------------------------ */
+void tdr_engine_set_qp_limit(tdr_engine *e, int limit);
+int tdr_engine_qp_limit(const tdr_engine *e);
+int tdr_engine_qp_live(const tdr_engine *e);
+
 /* Registration. Mirrors the reference's acquire+get_pages+dma_map
  * front-loading (amdp2p.c:112-264) collapsed into one call; dereg
  * mirrors put_pages+release (amdp2p.c:283-313, 345-360). */

@@ -133,27 +133,79 @@ int tdr_mr_cpu_foldable(const tdr_mr *mr) {
   return reinterpret_cast<const Mr *>(mr)->cpu_foldable() ? 1 : 0;
 }
 
+/* QP bring-up with engine-level budget accounting: the slot is
+ * reserved BEFORE the network is touched (an over-budget world fails
+ * fast without consuming the peer's accept) and released again when
+ * bring-up fails. Budget exhaustion is a configuration condition, not
+ * a transient — rebuilding cannot create QP headroom — so the error
+ * message deliberately matches no retryable marker. */
+namespace {
+
+bool qp_budget_admit(Engine *e) {
+  if (e->qp_admit()) return true;
+  tdr::set_error("qp budget exhausted: " +
+                 std::to_string(e->qp_live.load(std::memory_order_relaxed)) +
+                 " live of limit " +
+                 std::to_string(e->qp_limit.load(std::memory_order_relaxed)) +
+                 " on this engine");
+  return false;
+}
+
+tdr_qp *qp_budget_finish(Engine *e, Qp *q) {
+  if (!q) {
+    e->qp_release();
+    return nullptr;
+  }
+  q->owner = e;
+  return reinterpret_cast<tdr_qp *>(q);
+}
+
+}  // namespace
+
 tdr_qp *tdr_listen(tdr_engine *e, const char *bind_host, int port) {
-  return reinterpret_cast<tdr_qp *>(
-      reinterpret_cast<Engine *>(e)->listen(bind_host, port, -1));
+  Engine *eng = reinterpret_cast<Engine *>(e);
+  if (!qp_budget_admit(eng)) return nullptr;
+  return qp_budget_finish(eng, eng->listen(bind_host, port, -1));
 }
 
 tdr_qp *tdr_listen_timeout(tdr_engine *e, const char *bind_host, int port,
                            int timeout_ms) {
-  return reinterpret_cast<tdr_qp *>(
-      reinterpret_cast<Engine *>(e)->listen(bind_host, port, timeout_ms));
+  Engine *eng = reinterpret_cast<Engine *>(e);
+  if (!qp_budget_admit(eng)) return nullptr;
+  return qp_budget_finish(eng, eng->listen(bind_host, port, timeout_ms));
 }
 
 tdr_qp *tdr_connect(tdr_engine *e, const char *host, int port,
                     int timeout_ms) {
-  return reinterpret_cast<tdr_qp *>(
-      reinterpret_cast<Engine *>(e)->connect(host, port, timeout_ms));
+  Engine *eng = reinterpret_cast<Engine *>(e);
+  if (!qp_budget_admit(eng)) return nullptr;
+  return qp_budget_finish(eng, eng->connect(host, port, timeout_ms));
 }
 
 int tdr_qp_close(tdr_qp *qp) {
   Qp *q = reinterpret_cast<Qp *>(qp);
+  Engine *owner = q->owner;
   delete q;  // dtor performs the close/flush
+  if (owner) owner->qp_release();
   return 0;
+}
+
+void tdr_engine_set_qp_limit(tdr_engine *e, int limit) {
+  if (e)
+    reinterpret_cast<Engine *>(e)->qp_limit.store(
+        limit < 0 ? 0 : limit, std::memory_order_relaxed);
+}
+
+int tdr_engine_qp_limit(const tdr_engine *e) {
+  return e ? reinterpret_cast<const Engine *>(e)->qp_limit.load(
+                 std::memory_order_relaxed)
+           : 0;
+}
+
+int tdr_engine_qp_live(const tdr_engine *e) {
+  return e ? reinterpret_cast<const Engine *>(e)->qp_live.load(
+                 std::memory_order_relaxed)
+           : 0;
 }
 
 int tdr_post_write(tdr_qp *qp, tdr_mr *lmr, size_t loff, uint64_t raddr,

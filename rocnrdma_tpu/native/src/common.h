@@ -78,6 +78,10 @@ class Qp {
   // Telemetry track id — a process-wide bring-up ordinal, assigned
   // whether or not recording is on (it names the exported timeline).
   const uint32_t tel_id = tel_next_qp_id();
+  // Owning engine for live-QP accounting (set by the C API at
+  // bring-up; the engine must outlive its QPs, which the close
+  // discipline — QPs first, engine last — already requires).
+  Engine *owner = nullptr;
   virtual int post_write(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                          size_t len, uint64_t wr_id) = 0;
   virtual int post_read(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
@@ -133,6 +137,24 @@ class Engine {
   virtual ~Engine() = default;
   // Telemetry track id (open ordinal; see Qp::tel_id).
   const uint16_t tel_id = tel_next_engine_id();
+  // Live-QP accounting for multi-tenant engines (several concurrent
+  // worlds sharing one engine under a budget). qp_limit 0 = unlimited.
+  // Admission reserves a slot BEFORE the connection is attempted, so
+  // an over-budget bring-up fails fast without consuming the peer's
+  // accept; a failed bring-up releases the reservation.
+  std::atomic<int> qp_live{0};
+  std::atomic<int> qp_limit{0};
+  bool qp_admit() {
+    for (;;) {
+      int limit = qp_limit.load(std::memory_order_relaxed);
+      int live = qp_live.load(std::memory_order_relaxed);
+      if (limit > 0 && live >= limit) return false;
+      if (qp_live.compare_exchange_weak(live, live + 1,
+                                        std::memory_order_relaxed))
+        return true;
+    }
+  }
+  void qp_release() { qp_live.fetch_sub(1, std::memory_order_relaxed); }
   virtual int kind() const = 0;
   virtual const char *name() const = 0;
   virtual Mr *reg_mr(void *addr, size_t len, int access) = 0;

@@ -131,6 +131,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_engine_kind.argtypes = [P]
     lib.tdr_engine_name.restype = ctypes.c_char_p
     lib.tdr_engine_name.argtypes = [P]
+    lib.tdr_engine_set_qp_limit.restype = None
+    lib.tdr_engine_set_qp_limit.argtypes = [P, ctypes.c_int]
+    lib.tdr_engine_qp_limit.restype = ctypes.c_int
+    lib.tdr_engine_qp_limit.argtypes = [P]
+    lib.tdr_engine_qp_live.restype = ctypes.c_int
+    lib.tdr_engine_qp_live.argtypes = [P]
     lib.tdr_reg_mr.restype = P
     lib.tdr_reg_mr.argtypes = [P, P, ctypes.c_size_t, ctypes.c_int]
     lib.tdr_reg_dmabuf_mr.restype = P
@@ -951,7 +957,49 @@ class Engine:
     def __init__(self, spec: str = "auto"):
         self._h = _load().tdr_engine_open(spec.encode())
         _check(self._h, f"engine_open({spec})")
+        # Worlds currently hosted on this engine (RingWorld attaches at
+        # bootstrap, detaches at close). Multi-tenancy gates the
+        # engine-wide seal-context stamp: the incarnation fence is only
+        # meaningful while ONE world owns the engine — with co-tenant
+        # worlds at different generations the stamp is cleared and
+        # stale-world fencing falls back to the schedule-digest
+        # generation check (per world, per collective). A WeakSet, so
+        # an abandoned world (never closed — e.g. discarded after a
+        # non-retryable rebuild failure) stops counting once collected
+        # instead of permanently disabling the fence for its successor.
+        import weakref
+
+        self._worlds: "weakref.WeakSet" = weakref.WeakSet()
         trace.event("engine.open", kind=self.kind, backend=self.name)
+
+    def attach_world(self, world) -> None:
+        self._worlds.add(world)
+
+    def detach_world(self, world) -> None:
+        self._worlds.discard(world)
+
+    @property
+    def world_count(self) -> int:
+        """Number of RingWorlds currently attached to this engine."""
+        return len(self._worlds)
+
+    def set_qp_limit(self, limit: int) -> None:
+        """Cap live QPs on this engine (0 = unlimited). When the cap is
+        reached, listen/connect fail fast with a non-retryable budget
+        error — bring-up-time enforcement for engines shared by
+        concurrent worlds."""
+        _load().tdr_engine_set_qp_limit(_live(self._h, "set_qp_limit"),
+                                        int(limit))
+
+    @property
+    def qp_limit(self) -> int:
+        return int(_load().tdr_engine_qp_limit(
+            _live(self._h, "qp_limit")))
+
+    @property
+    def qp_live(self) -> int:
+        """Live QPs on this engine right now (all worlds combined)."""
+        return int(_load().tdr_engine_qp_live(_live(self._h, "qp_live")))
 
     @property
     def kind(self) -> int:

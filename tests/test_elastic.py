@@ -19,6 +19,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -26,13 +27,18 @@ STEPS = 4
 DIE_AT = 2  # rank 1 SIGKILLs itself inside step 2's gradient sync
 
 # One rank of the elastic training job. argv: rank base_port steps
-# ckpt_dir die_at (0 = never).
+# ckpt_dir die_at (0 = never) [world_size] [coordinator_address].
+# Without a coordinator address this is the LEGACY pairwise
+# rendezvous — the world-2 test below deliberately pins that fallback;
+# with one, rendezvous and every rebuild are arbitrated.
 RANK_SCRIPT = r"""
 import os, signal, sys
 import numpy as np
 
 rank = int(sys.argv[1]); base = int(sys.argv[2]); steps = int(sys.argv[3])
 ckdir = sys.argv[4]; die_at = int(sys.argv[5])
+world_sz = int(sys.argv[6]) if len(sys.argv) > 6 else 2
+ctl = sys.argv[7] if len(sys.argv) > 7 else ""
 
 from rocnrdma_tpu.transport.engine import Engine
 from rocnrdma_tpu.collectives.world import RingWorld
@@ -43,7 +49,9 @@ from rocnrdma_tpu.parallel.checkpoint import restore_checkpoint, \
 from rocnrdma_tpu.utils.trace import trace
 
 eng = Engine("emu")
-world = RingWorld(eng, rank, 2, base, timeout_ms=60000)
+world = RingWorld(eng, rank, world_sz, None if ctl else base,
+                  timeout_ms=60000, controller=(ctl or None),
+                  world_name="elastic")
 sync = CrossSliceAllReduce(world, mean=True)
 
 
@@ -71,7 +79,7 @@ ck = os.path.join(ckdir, f"rank{rank}")
 tr = Trainer("llama-tiny", {"dp": 1, "tp": 1}, seed=5, learning_rate=1e-2,
              cross_slice_sync=sync,
              elastic=ElasticPolicy(ck, save_every=1, max_resumes=6,
-                                   rebuild=dict(max_attempts=12,
+                                   rebuild=dict(max_attempts=20,
                                                 backoff_s=0.2,
                                                 backoff_cap_s=2.0,
                                                 timeout_ms=20000)))
@@ -81,7 +89,7 @@ if os.path.exists(ck + ".npz"):
     print("RESTORED", rank, start, flush=True)
 
 rng = np.random.default_rng(17)
-batches = [rng.integers(0, 255, (2, 2, 17)).astype(np.int32)
+batches = [rng.integers(0, 255, (world_sz, 2, 17)).astype(np.int32)
            for _ in range(steps)]
 for i in range(start, steps):
     tr.step(batches[i][rank])
@@ -101,7 +109,7 @@ def _free_base():
     return port
 
 
-def _spawn(rank, base, ckdir, die_at):
+def _spawn(rank, base, ckdir, die_at, world=2, ctl="", steps=STEPS):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
@@ -110,7 +118,7 @@ def _spawn(rank, base, ckdir, die_at):
     env["TDR_RING_TIMEOUT_MS"] = "30000"
     return subprocess.Popen(
         [sys.executable, "-c", RANK_SCRIPT, str(rank), str(base),
-         str(STEPS), ckdir, str(die_at)],
+         str(steps), ckdir, str(die_at), str(world), ctl],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
 
 
@@ -175,3 +183,75 @@ def test_sigkill_restart_resumes_bitwise_equal(tmp_path):
         assert clean[key].tobytes() == faulty[key].tobytes(), key
     for key in faulty:
         assert faulty[key].tobytes() == faulty_r1[key].tobytes(), key
+
+
+W8 = 8
+W8_STEPS = 3
+W8_DIE = (3, 6)  # two ranks SIGKILL themselves at the same step
+
+
+def _run_world8(ckdir, die, steps=W8_STEPS, timeout=900):
+    """One arbitrated world-8 run: coordinator in this process,
+    subprocess ranks; ``die`` ranks SIGKILL themselves inside step 2's
+    gradient sync and are restarted by the supervisor."""
+    from rocnrdma_tpu.control.coordinator import Coordinator
+
+    coord = Coordinator(port=0, lease_ms=8000,
+                        port_base=_free_base()).start()
+    try:
+        procs = {r: _spawn(r, 0, ckdir, die_at=2 if r in die else 0,
+                           world=W8, ctl=coord.address, steps=steps)
+                 for r in range(W8)}
+        outs = {}
+        for r in die:
+            procs[r].wait(timeout=timeout)
+            assert procs[r].returncode == -signal.SIGKILL, \
+                procs[r].returncode
+        # Restart the killed ranks, exactly as a supervisor would; the
+        # coordinator lease-expires (or supersedes) their dead
+        # incarnations and re-admits them under a bumped generation.
+        for r in die:
+            procs[r] = _spawn(r, 0, ckdir, die_at=0, world=W8,
+                              ctl=coord.address, steps=steps)
+        for r in range(W8):
+            outs[r] = _finish(procs[r], timeout=timeout)
+        return outs
+    finally:
+        coord.stop()
+
+
+@pytest.mark.slow
+def test_world8_two_simultaneous_kills_rejoin_bitwise(tmp_path):
+    """World 8 under the arbitrated control plane with TWO ranks
+    SIGKILLed at the same step and restarted: the coordinator declares
+    them dead, bumps the generation, re-admits the new incarnations,
+    and the run converges bitwise-equal to the uninterrupted world-8
+    run — kill + rejoin mid-training at the ROADMAP item-5 scale."""
+    clean_dir = str(tmp_path / "clean")
+    faulty_dir = str(tmp_path / "faulty")
+    os.makedirs(clean_dir)
+    os.makedirs(faulty_dir)
+
+    _run_world8(clean_dir, die=())
+    outs = _run_world8(faulty_dir, die=W8_DIE)
+
+    # Both restarted ranks came back from THEIR checkpoints.
+    for r in W8_DIE:
+        assert f"RESTORED {r}" in outs[r], outs[r]
+    # A surviving rank recovered through the full arbitrated path.
+    done = [l for l in outs[0].splitlines() if l.startswith("DONE 0")]
+    assert done, outs[0]
+    assert "resume=0" not in done[0], done[0]
+    assert "rebuild=0" not in done[0], done[0]
+
+    clean = _final_params(clean_dir, 0)
+    faulty = _final_params(faulty_dir, 0)
+    assert set(clean) == set(faulty)
+    for key in clean:
+        assert clean[key].tobytes() == faulty[key].tobytes(), key
+    # And every rank of the faulty run stayed in DP lockstep.
+    for r in range(1, W8):
+        other = _final_params(faulty_dir, r)
+        for key in faulty:
+            assert faulty[key].tobytes() == other[key].tobytes(), \
+                (r, key)

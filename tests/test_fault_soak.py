@@ -11,6 +11,7 @@ import os
 
 import jax
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -62,3 +63,55 @@ def test_soak_short_seeded_parity_mixed_plan(tmp_path):
     assert len(la) == len(lb)
     for a, b in zip(la, lb):
         assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+@pytest.mark.slow
+def test_soak_world4_arbitrated_flap_parity(tmp_path):
+    """The arbitrated control-plane path at world 4: rank 1 flaps
+    (tears its transport down mid-step and rejoins), every rebuild is
+    arbitrated by an in-process coordinator, and the run converges
+    bitwise-equal to the clean run — with every generation bump a
+    coordinator decision (ctl.* counters prove no rank guessed)."""
+    steps, seed = 2, 5
+    clean, _ = fs.run_soak(steps=steps, seed=seed, world=4,
+                           ckpt_dir=str(tmp_path / "clean"))
+    faulty, stats = fs.run_soak(steps=steps, seed=seed, world=4,
+                                ckpt_dir=str(tmp_path / "faulty"),
+                                coordinator=True, flap=(1, 2))
+    assert fs.params_equal(clean, faulty)
+    assert stats["resumes"] >= 1, stats
+    assert stats["ctl"].get("ctl.report", 0) >= 1, stats
+    assert stats["ctl"].get("ctl.rebuild", 0) >= 1, stats
+    assert stats["ctl"].get("ctl.release", 0) >= 2, stats
+    # All ranks ended on ONE coordinator-decided generation, > 0.
+    assert len(stats["generations"]) == 1, stats
+    assert stats["generations"][0] >= 1, stats
+
+
+@pytest.mark.slow
+def test_soak_world8_flap_two_faults_concurrent_parity(tmp_path):
+    """The ROADMAP item-5 acceptance soak, in-process: world 8 with a
+    flapping rank plus a second simultaneous failure class (sealed-
+    payload corruptions, healed by NAK/retransmit), TWO concurrent
+    named worlds sharing the engines, and every rebuild arbitrated —
+    bitwise-equal to the clean run. The riders are deliberately the
+    SELF-HEALING kind: process-wide ring/conn faults could land on
+    the deliberately-elastic-free side world (see _run_side_world);
+    the two-simultaneous-KILL case is the subprocess world-8 test in
+    test_elastic.py."""
+    steps, seed = 3, 8
+    plan = "send:nth=6:corrupt=3,send:nth=55:corrupt=2"
+    clean, _ = fs.run_soak(steps=steps, seed=seed, world=8,
+                           ckpt_dir=str(tmp_path / "clean"))
+    faulty, stats = fs.run_soak(steps=steps, seed=seed, world=8,
+                                ckpt_dir=str(tmp_path / "faulty"),
+                                fault_plan=plan, coordinator=True,
+                                flap=(3, 2), concurrent=True)
+    assert fs.params_equal(clean, faulty)
+    assert stats["fault_hits"] >= 2, stats
+    assert stats["resumes"] >= 1, stats
+    assert stats["integrity_failed"] >= 1, stats
+    assert stats["side_ok"], stats
+    assert stats["ctl"].get("ctl.rebuild", 0) >= 1, stats
+    assert len(stats["generations"]) == 1, stats
+    assert stats["generations"][0] >= 1, stats

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Control-plane smoke: coordinator + flapping-rank soak, bitwise.
+
+The CI hook for the arbitrated rendezvous path (make control-smoke /
+control-smoke-san): a world-4 elastic training soak where rank 1
+flaps (tears its transport down mid-step and rejoins), every rebuild
+is arbitrated by an in-process coordinator, a second named world
+shares the training engines for the whole run, and a scraper thread
+hits the coordinator's /metrics endpoint throughout. Asserts:
+
+- final params BITWISE equal to the uninterrupted run (the elastic
+  contract, unchanged under arbitration);
+- at least one arbitrated rebuild happened and every generation bump
+  was a coordinator decision (ctl.* counters prove arbitration ran);
+- the concurrent world stayed correct (multi-tenant engines under
+  chaos);
+- /metrics served the contract-pinned SLO names mid-soak (chunk p99,
+  retransmit rate, rebuild count);
+- the merged Perfetto export contains ctl.* events (a rebuild is
+  reconstructable from a trace).
+
+The -san variant (TDR_CONTROL_SMOKE_LITE=1) runs the TRAINER-FREE
+drive against the ASan+UBSan artifact: the same coordinator, flap,
+rebuild, concurrent-world, budget, and /metrics machinery over plain
+int32 ring allreduces — jax is never imported, because jaxlib's MLIR
+pybind throws C++ exceptions that trip ASan's __cxa_throw interceptor
+check (a toolchain incompatibility, not a defect under test). Every
+arbitration-path native interaction (QP churn from rebuilds, budget
+accounting, seal-context clears, NAK/retransmit from corrupt riders)
+still gets the full memory-error and UB sweep.
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["TDR_TELEMETRY"] = "1"
+
+LITE = os.environ.get("TDR_CONTROL_SMOKE_LITE", "0") not in ("", "0")
+
+# Contract-pinned metric names (tests/test_control.py pins the same).
+PINNED = (
+    "tdr_ctl_generation{",
+    "tdr_ctl_members{",
+    "tdr_ctl_rebuilds_total{",
+    "tdr_retransmit_rate{",
+)
+PINNED_SLO = (
+    'tdr_chunk_lat_us{world="train",quantile="0.99"}',
+    "tdr_integrity_retransmitted_total{",
+)
+
+
+def _lite_soak(coord_address, world, rounds, flap_round):
+    """Trainer-free chaos drive (the -san variant): world-N arbitrated
+    RingWorlds doing bitwise-checked int32 allreduces; at
+    ``flap_round`` one rank tears its transport down BEFORE posting,
+    so every rank fails that same round (ring transitivity — no rank
+    can complete a collective without every other), rebuilds through
+    the coordinator, and retries the round. A corrupt rider keeps the
+    NAK/retransmit ladder active under the sanitizer. Returns
+    (parity_ok, rebuild_events)."""
+    import numpy as np
+
+    from rocnrdma_tpu.collectives.world import RingWorld
+    from rocnrdma_tpu.transport.engine import (Engine, TransportError,
+                                               fault_plan_reset)
+    from rocnrdma_tpu.utils.trace import trace
+
+    os.environ["TDR_FAULT_PLAN"] = "send:nth=9:corrupt=3"
+    fault_plan_reset()
+    rng = np.random.default_rng(17)
+    data = rng.integers(-999, 999, (rounds, world, 8192)).astype(np.int32)
+    expected = data.sum(axis=1, dtype=np.int64).astype(np.int32)
+    engines = [Engine("emu") for _ in range(world)]
+    worlds = [None] * world
+    errs = [None] * world
+
+    def boot(r):
+        try:
+            worlds[r] = RingWorld(engines[r], r, world, timeout_ms=15000,
+                                  controller=coord_address,
+                                  world_name="train", channels=2)
+        except BaseException as e:
+            errs[r] = e
+
+    ts = [threading.Thread(target=boot, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+    import fault_soak as fs
+
+    side_errs = [None] * world
+    side_threads, side_finish = fs._run_side_world(
+        engines, world, rounds, 3, None, coord_address, side_errs)
+
+    def drive(r):
+        try:
+            w = worlds[r]
+            for i in range(rounds):
+                for attempt in range(5):
+                    if r == 1 and i == flap_round and attempt == 0:
+                        w._teardown()  # the flap: die before posting
+                    buf = data[i, r].copy()
+                    try:
+                        w.allreduce(buf)
+                    except TransportError as e:
+                        if not e.retryable:
+                            raise
+                        w.rebuild(max_attempts=8, backoff_s=0.05,
+                                  backoff_cap_s=0.5, timeout_ms=10000)
+                        continue
+                    assert buf.tobytes() == expected[i].tobytes(), \
+                        f"round {i} rank {r} diverged"
+                    break
+                else:
+                    raise RuntimeError(f"round {i} never converged")
+        except BaseException as e:
+            errs[r] = e
+
+    ts = [threading.Thread(target=drive, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for t in side_threads:
+        t.join(timeout=120)
+    side_finish()
+    for w in worlds:
+        if w is not None:
+            w.close()
+    for e in engines:
+        e.close()
+    os.environ.pop("TDR_FAULT_PLAN", None)
+    fault_plan_reset()
+    for e in errs + side_errs:
+        if e is not None:
+            raise e
+    return {"rebuilds": trace.counter("world.rebuild"),
+            "ctl": {k: v for k, v in
+                    trace.counters_prefixed("ctl.").items()},
+            "generations": sorted({w.generation for w in worlds}),
+            "resumes": 0,
+            "side_ok": all(e is None for e in side_errs)}
+
+
+def main() -> int:
+    from rocnrdma_tpu.control.client import ControlClient
+    from rocnrdma_tpu.control.coordinator import Coordinator
+    from rocnrdma_tpu.telemetry.perfetto import export_trace
+    from rocnrdma_tpu.transport.engine import telemetry_reset
+    from rocnrdma_tpu.utils.trace import trace
+
+    import fault_soak as fs  # no jax at module level: lite-safe
+
+    telemetry_reset()
+    world, steps, seed = 4, 3, 3
+    coord = Coordinator(port=0, lease_ms=3000,
+                        port_base=fs.free_port()).start()
+    client = ControlClient(coord.address)
+    scrapes = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.wait(1.0):
+            try:
+                scrapes.append(client.metrics())
+            except Exception:
+                pass
+
+    st = threading.Thread(target=scraper, daemon=True)
+    st.start()
+
+    try:
+        if LITE:
+            stats = _lite_soak(coord.address, world, rounds=6,
+                               flap_round=2)
+            parity = True  # every round was bitwise-checked in place
+        else:
+            # A corruption rider keeps the integrity ladder (and its
+            # /metrics series) active; the flap is the headline chaos.
+            plan = (f"send:nth=7:corrupt=3,"
+                    f"send:nth={steps * world * 3}:corrupt=2")
+            with tempfile.TemporaryDirectory(
+                    prefix="tdr_ctl_smoke_") as d:
+                clean, _ = fs.run_soak(steps=steps, seed=seed,
+                                       world=world,
+                                       ckpt_dir=os.path.join(d, "clean"))
+                faulty, stats = fs.run_soak(
+                    steps=steps, seed=seed, world=world,
+                    ckpt_dir=os.path.join(d, "faulty"), fault_plan=plan,
+                    coordinator=coord.address, flap=(1, 2),
+                    concurrent=True)
+            parity = fs.params_equal(clean, faulty)
+        # One last scrape while the coordinator still holds the
+        # worlds' final state.
+        scrapes.append(client.metrics())
+    finally:
+        stop.set()
+        st.join(timeout=5)
+    final = scrapes[-1]
+    pinned_ok = all(any(p in s for s in scrapes) for p in PINNED)
+    slo_ok = all(p in final for p in PINNED_SLO)
+    rebuild_line = [ln for ln in final.splitlines()
+                    if ln.startswith('tdr_ctl_rebuilds_total{world="train"')]
+    rebuilds_served = int(rebuild_line[0].split()[-1]) if rebuild_line else 0
+
+    doc = export_trace(os.path.join(tempfile.gettempdir(),
+                                    "tdr_control_smoke_trace.json"))
+    ctl_events = sorted({e["name"] for e in doc["traceEvents"]
+                         if str(e.get("name", "")).startswith("ctl.")})
+
+    coord.stop()
+    verdict = {
+        "parity": parity,
+        "lite": LITE,
+        "world": world,
+        "steps": steps,
+        "arbitrated_rebuilds": stats["ctl"].get("ctl.rebuild", 0),
+        "rebuilds_served_on_metrics": rebuilds_served,
+        "generations": stats["generations"],
+        "side_ok": stats["side_ok"],
+        "pinned_names_scraped": pinned_ok,
+        "slo_names_on_final_scrape": slo_ok,
+        "scrapes": len(scrapes),
+        "ctl_events_in_perfetto": ctl_events,
+        "trainer_resumes": stats["resumes"],
+    }
+    ok = (parity and stats["side_ok"] and pinned_ok and slo_ok
+          and verdict["arbitrated_rebuilds"] >= 1
+          and rebuilds_served >= 1 and len(ctl_events) >= 2
+          and trace.counter("ctl.release") >= 1)
+    verdict["ok"] = ok
+    print(json.dumps(verdict, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,19 +1,35 @@
 #!/usr/bin/env python
 """Fault-plan soak runner: elastic training under injected faults.
 
-Runs the same N-step, 2-rank cross-slice DP training twice — once
-clean, once under a randomized-but-seeded ``TDR_FAULT_PLAN`` — with
-the trainer's elastic policy armed, and asserts the final parameters
-of the faulty run are BITWISE identical to the clean run's. That is
-the whole detect→recover contract in one predicate: the injected
-transient fault fired (hit counters say so), both ranks rebuilt the
-world under a new generation, restored their checkpoints, re-ran the
-failed step, and the trajectory converged to exactly what an
-uninterrupted run produces.
+Runs the same N-step, world-N in-process DP training twice — once
+clean, once under chaos — with the trainer's elastic policy armed, and
+asserts the final parameters of the chaotic run are BITWISE identical
+to the clean run's. That is the whole detect→recover contract in one
+predicate: every injected fault fired (hit counters say so), the ranks
+rebuilt the world under a new generation, restored their checkpoints,
+re-ran the failed step, and the trajectory converged to exactly what
+an uninterrupted run produces.
 
-CLI: ``python tools/fault_soak.py [--steps N] [--seed S] [--plan SPEC]``
-prints a JSON verdict. The test suite wires a short seeded
-configuration in as a tier-1 test (tests/test_fault_soak.py).
+Chaos riders beyond the classic ``TDR_FAULT_PLAN``:
+
+- ``--coordinator``: run an in-process rendezvous coordinator and
+  arbitrate every rebuild through it (``rocnrdma_tpu.control``) — no
+  rank-local generation guesses; every bump is a coordinator decision
+  observable as ``ctl.*`` events.
+- ``--flap R@N``: a flapping rank — rank R tears its transport down on
+  its Nth gradient sync (connections die mid-step on every peer, the
+  in-process stand-in for a SIGKILL) and rejoins through the elastic
+  ladder; the multi-process SIGKILL variant lives in
+  tests/test_elastic.py.
+- ``--concurrent``: a second named world ("side") SHARING the training
+  ranks' engines runs integer allreduces the whole time, each checked
+  bitwise — multi-tenant engines under chaos.
+
+CLI: ``python tools/fault_soak.py [--steps N] [--seed S] [--plan SPEC]
+[--world W] [--coordinator] [--flap R@N] [--concurrent]
+[--perfetto PATH]`` prints a JSON verdict. The test suite wires short
+seeded configurations in (tests/test_fault_soak.py); the world-8
+acceptance soak is the slow-marked case there.
 """
 import argparse
 import json
@@ -42,7 +58,7 @@ def make_fault_plan(seed: int, steps: int, world: int = 2) -> str:
     plus a seeded payload corruption on the sealed zero-copy path.
 
     ``ring:nth`` counts tdr_ring_allreduce calls process-wide (~world
-    per training step with both ranks in-process), so the same seed
+    per training step with all ranks in-process), so the same seed
     always faults the same call ordinal; which rank's thread lands on
     it may vary, but the parity predicate is rank-independent.
 
@@ -61,13 +77,119 @@ def make_fault_plan(seed: int, steps: int, world: int = 2) -> str:
     return plan
 
 
+class FlapRider:
+    """Tear this rank's transport down on its Nth gradient sync — a
+    rank "flaps" mid-step, deterministically, without leaving the
+    process: the torn QPs surface as connection drops on every peer,
+    the local collective raises a retryable torn-down error, and the
+    whole world walks the elastic ladder (report → arbitrated rejoin
+    when a coordinator is armed)."""
+
+    def __init__(self, inner, world, at: int):
+        self.inner = inner
+        self.flap_world = world
+        self.at = at
+        self.n = 0
+        self.fired = False
+
+    def __call__(self, tree):
+        self.n += 1
+        if not self.fired and self.at > 0 and self.n == self.at:
+            self.fired = True
+            self.flap_world._teardown()
+        return self.inner(tree)
+
+    def __getattr__(self, name):  # .world / .reset_transport_cache
+        return getattr(self.inner, name)
+
+
+def _run_side_world(engines, world, steps, seed, base_port, controller,
+                    errs):
+    """The concurrent-tenant workload: a second named world over the
+    SAME engines as the training world, doing int32 allreduces (sum is
+    associative, so the expected result is exact) checked bitwise on
+    every iteration.
+
+    The side world carries NO elastic machinery, deliberately — it
+    proves that a co-tenant world stays correct while the training
+    world flaps and rebuilds around it. That also means injected
+    faults at process-wide sites (``ring:``, ``conn:``) can land on it
+    and kill the soak: when running ``--concurrent``, restrict the
+    fault plan to self-healing riders (``send:...:corrupt=``, whose
+    NAK/retransmit ladder heals whichever world they hit) plus the
+    flap, which targets the training world alone.
+
+    Returns ``(threads, finish)``: call ``finish()`` after joining the
+    threads — ranks that SUCCEEDED keep their world open until every
+    side rank is done (closing early would flush a slower neighbor's
+    in-flight tail), while failed ranks close immediately inside the
+    thread to unblock their peers."""
+    import numpy as np
+
+    from rocnrdma_tpu.collectives.world import RingWorld
+
+    iters = max(2, steps * 2)
+    rng = np.random.default_rng(900 + seed)
+    # per-iteration per-rank payloads + exact expected sums, computed
+    # up front so every rank checks against the same oracle.
+    data = rng.integers(-1000, 1000,
+                        (iters, world, 4096)).astype(np.int32)
+    expected = data.sum(axis=1, dtype=np.int64).astype(np.int32)
+    worlds = [None] * world
+
+    def side_rank(r):
+        try:
+            w = RingWorld(engines[r], r, world, base_port,
+                          timeout_ms=20000, channels=1,
+                          controller=controller, world_name="side")
+            worlds[r] = w
+            for i in range(iters):
+                buf = data[i, r].copy()
+                w.allreduce(buf)
+                if buf.tobytes() != expected[i].tobytes():
+                    raise AssertionError(
+                        f"side world diverged at iter {i} rank {r}")
+        except BaseException as e:
+            errs[r] = e
+            # Unblock peers promptly: closing flushes everything they
+            # posted against this rank.
+            if worlds[r] is not None:
+                try:
+                    worlds[r].close()
+                except Exception:
+                    pass
+                worlds[r] = None
+
+    def finish():
+        for w in worlds:
+            if w is not None:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=side_rank, args=(r,),
+                                name=f"side-{r}") for r in range(world)]
+    for t in threads:
+        t.start()
+    return threads, finish
+
+
 def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
-             fault_plan=None, config: str = "llama-tiny"):
-    """Train ``steps`` steps of 2-rank DP (in-process ring) with the
-    elastic policy armed, optionally under ``fault_plan``. Returns
-    ``(params, stats)``: rank 0's final params as numpy leaves (both
-    ranks are asserted bitwise identical first) and the observability
-    counters (fault hits, resumes, rebuilds)."""
+             fault_plan=None, config: str = "llama-tiny", world: int = 2,
+             coordinator=None, flap=None, concurrent: bool = False,
+             channels=None):
+    """Train ``steps`` steps of world-N DP (in-process ring) with the
+    elastic policy armed, optionally under ``fault_plan`` and the
+    chaos riders. Returns ``(params, stats)``: rank 0's final params
+    as numpy leaves (all ranks are asserted bitwise identical first)
+    and the observability counters (fault hits, resumes, rebuilds,
+    ctl.* arbitration activity, final generation).
+
+    ``coordinator``: None (legacy pairwise path), True (spawn an
+    in-process Coordinator), or a "host:port" address. ``flap``: a
+    (rank, nth_sync) tuple arming a FlapRider. ``concurrent``: run the
+    "side" world over the same engines for the whole soak."""
     import jax
     import numpy as np
 
@@ -80,7 +202,6 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
                                                seal_counters)
     from rocnrdma_tpu.utils.trace import trace
 
-    world = 2
     if base_port is None:
         base_port = free_port()
     if ckpt_dir is None:
@@ -90,6 +211,17 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
     batches = [data_rng.integers(0, 255, (world, 2, 17)).astype(np.int32)
                for _ in range(steps)]
 
+    coord = None
+    ctl_address = None
+    if coordinator is True:
+        from rocnrdma_tpu.control.coordinator import Coordinator
+
+        coord = Coordinator(port=0, lease_ms=3000,
+                            port_base=free_port()).start()
+        ctl_address = coord.address
+    elif coordinator:
+        ctl_address = str(coordinator)
+
     prev_plan = os.environ.get("TDR_FAULT_PLAN")
     if fault_plan is not None:
         os.environ["TDR_FAULT_PLAN"] = fault_plan
@@ -98,33 +230,51 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
     fault_plan_reset()
     resumes0 = trace.counter("trainer.resume")
     rebuilds0 = trace.counter("world.rebuild")
+    ctl0 = trace.counters_prefixed("ctl.")
     seal0 = seal_counters()
 
+    engines = [Engine("emu") for _ in range(world)]
     results = [None] * world
+    finals = [None] * world  # final (generation, epoch) per rank
     errs = [None] * world
+    side_errs = [None] * world
+    side_threads = []
+    side_finish = None
 
     def run_rank(r: int):
-        eng = Engine("emu")
-        w = RingWorld(eng, r, world, base_port, timeout_ms=20000)
-        sync = CrossSliceAllReduce(w, mean=True)
-        tr = Trainer(config, {"dp": 1, "tp": 1}, seed=11,
-                     learning_rate=1e-2, cross_slice_sync=sync,
-                     elastic=ElasticPolicy(
-                         os.path.join(ckpt_dir, f"rank{r}"),
-                         save_every=1, max_resumes=4,
-                         rebuild=dict(max_attempts=10, backoff_s=0.05,
-                                      backoff_cap_s=1.0,
-                                      timeout_ms=10000)))
+        w = sync = None
         try:
+            w = RingWorld(engines[r], r, world,
+                          None if ctl_address else base_port,
+                          timeout_ms=20000, channels=channels,
+                          controller=ctl_address, world_name="train")
+            sync = CrossSliceAllReduce(w, mean=True)
+            hooked = sync
+            if flap is not None and flap[0] == r:
+                hooked = FlapRider(sync, w, flap[1])
+            tr = Trainer(config, {"dp": 1, "tp": 1}, seed=11,
+                         learning_rate=1e-2, cross_slice_sync=hooked,
+                         elastic=ElasticPolicy(
+                             os.path.join(ckpt_dir, f"rank{r}"),
+                             save_every=1, max_resumes=4,
+                             rebuild=dict(max_attempts=10, backoff_s=0.05,
+                                          backoff_cap_s=1.0,
+                                          timeout_ms=10000)))
             for i in range(steps):
                 tr.step(batches[i][r])
             results[r] = jax.tree_util.tree_map(np.asarray, tr.params)
+            finals[r] = (w.generation, getattr(w, "_ctl_epoch", 0))
         except BaseException as e:  # surfaced after join
             errs[r] = e
         finally:
             # Close promptly either way so a failed rank never leaves
             # its peer riding out the stall deadline.
-            for closer in (sync.close, w.close, eng.close):
+            closers = []
+            if sync is not None:
+                closers.append(sync.close)
+            if w is not None:
+                closers.append(w.close)
+            for closer in closers:
                 try:
                     closer()
                 except Exception:
@@ -133,11 +283,20 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
     threads = [threading.Thread(target=run_rank, args=(r,))
                for r in range(world)]
     try:
+        if concurrent:
+            side_threads, side_finish = _run_side_world(
+                engines, world, steps, seed,
+                None if ctl_address else base_port + world + 8,
+                ctl_address, side_errs)
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        for t in side_threads:
+            t.join(timeout=300)
     finally:
+        if side_finish is not None:
+            side_finish()
         hits = sum(fault_plan_hits(i)
                    for i in range(fault_plan_clauses()))
         if prev_plan is None:
@@ -145,15 +304,26 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
         else:
             os.environ["TDR_FAULT_PLAN"] = prev_plan
         fault_plan_reset()
-    for e in errs:
+        for eng in engines:
+            try:
+                eng.close()
+            except Exception:
+                pass
+        if coord is not None:
+            coord.stop()
+    for e in errs + side_errs:
         if e is not None:
             raise e
 
     leaves0 = jax.tree_util.tree_leaves(results[0])
-    leaves1 = jax.tree_util.tree_leaves(results[1])
-    for a, b in zip(leaves0, leaves1):
-        if np.asarray(a).tobytes() != np.asarray(b).tobytes():
-            raise AssertionError("ranks diverged: DP lockstep broken")
+    for r in range(1, world):
+        leaves_r = jax.tree_util.tree_leaves(results[r])
+        for a, b in zip(leaves0, leaves_r):
+            if np.asarray(a).tobytes() != np.asarray(b).tobytes():
+                raise AssertionError(
+                    f"ranks 0 and {r} diverged: DP lockstep broken")
+    gens = sorted(set(f[0] for f in finals if f is not None))
+    ctl1 = trace.counters_prefixed("ctl.")
     seal1 = seal_counters()
     stats = {
         "fault_hits": int(hits),
@@ -163,6 +333,13 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
         # corruptions and the retransmissions that healed them.
         "integrity_failed": seal1["failed"] - seal0["failed"],
         "retransmits": seal1["retransmitted"] - seal0["retransmitted"],
+        # Arbitration activity (coordinator runs only): every
+        # generation decision observable as ctl.* counters.
+        "ctl": {k: v - ctl0.get(k, 0) for k, v in ctl1.items()
+                if v - ctl0.get(k, 0) > 0},
+        "generations": gens,
+        "flapped": bool(flap),
+        "side_ok": concurrent and all(e is None for e in side_errs),
     }
     return results[0], stats
 
@@ -181,22 +358,57 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--world", type=int, default=2)
     ap.add_argument("--plan", default=None,
                     help="explicit TDR_FAULT_PLAN (default: seeded random)")
+    ap.add_argument("--coordinator", action="store_true",
+                    help="arbitrate rebuilds through an in-process "
+                         "rendezvous coordinator")
+    ap.add_argument("--flap", default=None, metavar="R@N",
+                    help="rank R tears its transport down on its Nth "
+                         "gradient sync and rejoins")
+    ap.add_argument("--concurrent", action="store_true",
+                    help="run a second named world over the same "
+                         "engines for the whole soak")
+    ap.add_argument("--perfetto", default=None, metavar="PATH",
+                    help="write a merged Perfetto trace of the faulty "
+                         "run (ctl.* arbitration events included)")
     args = ap.parse_args(argv)
 
-    plan = args.plan or make_fault_plan(args.seed, args.steps)
+    flap = None
+    if args.flap:
+        r, _, n = args.flap.partition("@")
+        flap = (int(r), int(n or 2))
+
+    if args.plan is not None:
+        plan = args.plan
+    elif args.concurrent:
+        # Default plan under --concurrent: self-healing corrupt riders
+        # only — a process-wide ring/conn fault could land on the
+        # deliberately-elastic-free side world (see _run_side_world).
+        rng = random.Random(args.seed)
+        plan = ",".join(
+            f"send:nth={rng.randrange(1, max(2, args.steps * args.world * k))}"
+            f":corrupt={rng.randrange(1, 5)}" for k in (1, 4))
+    else:
+        plan = make_fault_plan(args.seed, args.steps, args.world)
     with tempfile.TemporaryDirectory(prefix="tdr_soak_") as d:
-        clean, _ = run_soak(args.steps, args.seed,
+        clean, _ = run_soak(args.steps, args.seed, world=args.world,
                             ckpt_dir=os.path.join(d, "clean"))
-        faulty, stats = run_soak(args.steps, args.seed,
+        faulty, stats = run_soak(args.steps, args.seed, world=args.world,
                                  ckpt_dir=os.path.join(d, "faulty"),
-                                 fault_plan=plan)
+                                 fault_plan=plan or None,
+                                 coordinator=args.coordinator,
+                                 flap=flap, concurrent=args.concurrent)
+    if args.perfetto:
+        from rocnrdma_tpu.telemetry.perfetto import export_trace
+
+        export_trace(args.perfetto)
     ok = params_equal(clean, faulty)
-    out = {"steps": args.steps, "seed": args.seed, "plan": plan,
-           "parity": ok, **stats}
+    out = {"steps": args.steps, "seed": args.seed, "world": args.world,
+           "plan": plan, "parity": ok, **stats}
     print(json.dumps(out))
-    if stats["fault_hits"] == 0:
+    if plan and stats["fault_hits"] == 0:
         print("WARNING: fault plan never fired (plan points past the "
               "run?) — parity is vacuous", file=sys.stderr)
     return 0 if ok else 1

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Run the rendezvous coordinator as a standalone service.
+
+The control-plane process for a fleet: workers point
+``RingWorld(controller="host:port", world_name=...)`` at it, it hands
+out ring positions / base ports / generations, holds member leases,
+arbitrates elastic rejoin, and serves Prometheus-style SLOs on
+``GET /metrics`` over the same port (chunk p99, retransmit rate, NAK
+count, rebuild/generation count, lease expiries).
+
+    python tools/tdr_rendezvous.py --port 7070 --lease-ms 5000 \
+        --port-base 36000
+
+Stdlib-only; one process owns all lifecycle state (the "single owner
+of lifecycle state" stance of the DMA streaming framework applied to
+membership). SIGINT/SIGTERM shut it down cleanly.
+"""
+import argparse
+import os
+import signal
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="0.0.0.0",
+                    help="bind address (default all interfaces)")
+    ap.add_argument("--port", type=int, default=7070,
+                    help="TCP port (0 = ephemeral, printed at start)")
+    ap.add_argument("--lease-ms", type=int, default=5000,
+                    help="member lease TTL; a rank that misses it is "
+                         "declared dead and the generation bumps")
+    ap.add_argument("--port-base", type=int, default=36000,
+                    help="start of the port pool carved into per-world "
+                         "base-port ranges")
+    ap.add_argument("--port-stride", type=int, default=64,
+                    help="ports reserved per world (>= world size)")
+    ap.add_argument("--qp-budget", type=int, default=0,
+                    help="per-world QP budget handed to members at "
+                         "join (0 = unlimited)")
+    args = ap.parse_args(argv)
+
+    from rocnrdma_tpu.control.coordinator import Coordinator
+
+    coord = Coordinator(host=args.host, port=args.port,
+                        lease_ms=args.lease_ms,
+                        port_base=args.port_base,
+                        port_stride=args.port_stride,
+                        qp_budget=args.qp_budget).start()
+    print(f"tdr-rendezvous listening on {coord.address} "
+          f"(lease {args.lease_ms} ms, port pool {args.port_base}+"
+          f"{args.port_stride}/world, metrics: GET /metrics)",
+          flush=True)
+
+    done = threading.Event()
+
+    def _stop(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    done.wait()
+    coord.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
