@@ -89,6 +89,33 @@ def rebuild_jitter_seed() -> int:
         return 0
 
 
+def auto_channel_cap(peers: Optional[Sequence[str]] = None,
+                     rank: int = 0) -> int:
+    """Per-host channel cap applied by ``RingWorld(channels="auto")``:
+    the TDR_RING_CHANNELS default capped at usable-cores-per-local-rank
+    — the PR 4 saturation note made executable. On an in-process or
+    in-host world every channel is another pair of transport progress
+    threads; past cores/ranks they only preempt each other, which is
+    why blind channel counts sweep non-monotonically (BENCH_r06:
+    2ch 1.137 GB/s > 4ch 0.799). Local ranks are counted as peers
+    sharing this rank's host entry; an ABSENT peer list carries no
+    locality information, so only the core count caps (RingWorld
+    always passes its resolved peer list, where a defaulted world is
+    all-loopback and every rank counts as local)."""
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    if peers:
+        me = peers[rank] if 0 <= rank < len(peers) else peers[0]
+        local = max(1, sum(1 for p in peers if p == me))
+    else:
+        local = 1
+    from rocnrdma_tpu.transport.engine import ring_channels_default
+
+    return max(1, min(ring_channels_default(), max(1, cores // local)))
+
+
 class RingWorld:
     def __init__(
         self,
@@ -100,7 +127,7 @@ class RingWorld:
         bind_host: str = "0.0.0.0",
         timeout_ms: int = 30000,
         generation: int = 0,
-        channels: Optional[int] = None,
+        channels=None,  # int, None (env default), or "auto" (host cap)
         controller=None,
         world_name: str = "default",
         qp_budget: Optional[int] = None,
@@ -124,8 +151,22 @@ class RingWorld:
         # progress engines. Channel c of my right neighbor link IS
         # channel c of that rank's left link — guaranteed by bringing
         # the connections up strictly in channel order below.
-        self.channels = int(channels) if channels is not None else \
-            ring_channels_default()
+        # channels="auto" applies the per-host cores-vs-ranks cap
+        # (auto_channel_cap) instead of blindly taking the env count;
+        # the digest still carries the RESOLVED count, so ranks whose
+        # auto answers diverge fail the first collective fast.
+        if isinstance(channels, str):
+            if channels != "auto":
+                raise ValueError(f"channels={channels!r}: expected an "
+                                 "int or 'auto'")
+            # self.peers, never the raw argument: a None peer list has
+            # already defaulted to all-loopback above, which is the
+            # all-ranks-local case the cap exists for.
+            self.channels = auto_channel_cap(self.peers, rank)
+        elif channels is not None:
+            self.channels = int(channels)
+        else:
+            self.channels = ring_channels_default()
         if self.channels < 1:
             raise ValueError("channels must be >= 1")
         # Incarnation number of this ring; monotonic. Legacy path: the

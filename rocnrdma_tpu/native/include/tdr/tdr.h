@@ -90,6 +90,18 @@ size_t tdr_copy_pool_workers(void);
  * set to 0). */
 size_t tdr_fold_pool_workers(void);
 
+/* Effective progress-shard count for a ring with `channels` channels
+ * (the sharded progress engine, TDR_PROGRESS_SHARDS): how many
+ * dedicated poll threads a striped collective will run, each owning a
+ * disjoint channel group. 0 = the legacy single-poll loop (the
+ * schedule's calling thread owns all polling; TDR_PROGRESS_SHARDS=0
+ * forces it). Default: one shard per channel, capped at the host's
+ * usable cores — a core-starved host gains nothing from shards that
+ * only preempt each other. Per-PROCESS execution strategy: never
+ * negotiated, never part of the schedule digest (any mix of shard
+ * counts across ranks is wire-compatible and bitwise-identical). */
+int tdr_progress_shards(int channels);
+
 /* Cumulative bytes moved via the streaming (non-temporal) vs cached
  * (memcpy) copy tiers since process start — which path carried the
  * traffic (bench/diagnostics). */
@@ -226,10 +238,23 @@ enum {
                               FOLD event fires when the worker runs
                               it — the gap between the two is queue
                               wait, fold-pool pressure made visible) */
+  TDR_TEL_SHARD = 19,      /* progress-shard drain batch: qp=the
+                              shard thread's track id, id=shard
+                              ordinal, arg=completions consumed.
+                              Emitted with engine=0 (process-level,
+                              like the copy pool's events): batch
+                              boundaries ride thread timing, so they
+                              must not perturb per-engine replay
+                              shapes. */
 };
 
-/* Histograms (tdr_tel_hist_read). Log2 buckets: bucket b (1..63)
- * counts values in [2^(b-1), 2^b); bucket 0 counts zeros. */
+/* Histograms. Recorded at log-linear ("log2 × 8") resolution: 8
+ * linear sub-buckets per power-of-two octave (values 0..15 exact),
+ * bounding the relative quantization error at 12.5% — percentile
+ * estimates are real numbers, not octave edges (the BENCH_r06
+ * saturation: every latency percentile read 8191/32767/65535).
+ * tdr_tel_hist_read folds the fine rows back into the legacy
+ * 64-octave view; tdr_tel_hist_read_fine exposes the fine rows. */
 enum {
   TDR_HIST_CHUNK_LAT_US = 0, /* post → completion latency, us    */
   TDR_HIST_CHUNK_BYTES = 1,  /* completed op payload sizes       */
@@ -238,6 +263,10 @@ enum {
   TDR_HIST_RING_MBPS = 4,    /* whole-collective bandwidth, MB/s */
   TDR_HIST_COUNT = 5,
 };
+
+/* Fine rows: 16 exact small-value buckets + 8 sub-buckets for each of
+ * the 60 octaves above them (indices 16..495), padded to 512. */
+#define TDR_HIST_FINE_BUCKETS 512
 
 typedef struct {
   uint64_t ts_ns;  /* CLOCK_MONOTONIC */
@@ -262,6 +291,14 @@ const char *tdr_tel_event_name(int type);
 int tdr_tel_hist_count(void);
 const char *tdr_tel_hist_name(int which);
 void tdr_tel_hist_read(int which, uint64_t out[64]);
+/* Fine (log2 × 8) histogram rows: bucket count, the inclusive upper
+ * edge of a fine bucket (the conservative percentile estimate — the
+ * Python side calls this instead of re-deriving the edge math), and
+ * the row itself (fills min(max, TDR_HIST_FINE_BUCKETS), returns the
+ * number written). */
+int tdr_tel_hist_fine_buckets(void);
+uint64_t tdr_tel_hist_fine_upper(int idx);
+int tdr_tel_hist_read_fine(int which, uint64_t *out, int max);
 /* Stable per-process track ids (assigned at open/bring-up whether or
  * not telemetry is enabled — they also label exported timelines). */
 int tdr_tel_engine_id(const tdr_engine *e);

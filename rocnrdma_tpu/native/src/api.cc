@@ -18,6 +18,10 @@ size_t tdr_copy_pool_workers(void) { return tdr::copy_pool_workers(); }
 
 size_t tdr_fold_pool_workers(void) { return tdr::fold_pool_workers(); }
 
+int tdr_progress_shards(int channels) {
+  return tdr::progress_shards_for(channels < 1 ? 1 : channels);
+}
+
 void tdr_copy_counters(uint64_t *nt_bytes, uint64_t *plain_bytes) {
   tdr::copy_counters(nt_bytes, plain_bytes);
 }

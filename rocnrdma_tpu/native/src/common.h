@@ -5,9 +5,12 @@
 #include <sys/types.h>
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <functional>
+#include <mutex>
 #include <string>
 
 #include "tdr/tdr.h"
@@ -42,6 +45,11 @@ void tel_emit(uint16_t type, uint16_t engine, uint32_t qp, uint64_t id,
 void tel_hist_add(int which, uint64_t value);
 uint16_t tel_next_engine_id();
 uint32_t tel_next_qp_id();
+// Stable per-THREAD track id (lazily drawn from the QP track space):
+// names the timeline lane of helper threads that are not QPs — fold
+// workers and ring progress shards — so exported traces show their
+// work as parallel lanes instead of folding it into the engine track.
+uint32_t tel_thread_track();
 
 // One-branch event site: evaluates its arguments only when recording.
 #define TDR_TEL(type, eng, qp, id, arg)                                  \
@@ -112,6 +120,12 @@ class Qp {
   // (wire-incompatible with the rightward-only schedules); both ends
   // must advertise it in the handshake before a ring may enter it.
   virtual bool has_fused2() const { return false; }
+  // THREAD-SAFETY CONTRACT for poll(): poll may run concurrently with
+  // posts on the same QP and with polls/posts on OTHER QPs (each
+  // backend's completion queue is internally locked). Concurrent
+  // polls on the SAME QP are also safe — each completion is delivered
+  // to exactly one poller — but they race for completions, so the
+  // sharded progress engine assigns every QP to exactly one shard.
   // Engines whose reduce-on-receive stages through bounded slots (the
   // verbs backend: an HCA has no fold ALU) advertise how many
   // recv_reduce postings may be in flight; 0 = unbounded (emu folds
@@ -137,6 +151,40 @@ class Engine {
   virtual ~Engine() = default;
   // Telemetry track id (open ordinal; see Qp::tel_id).
   const uint16_t tel_id = tel_next_engine_id();
+  // Engine-wide completion pulse: a monotonically-stamped "some QP on
+  // this engine delivered a completion" signal, so a waiter watching
+  // SEVERAL QPs (a progress shard owning a channel group) can park on
+  // one condvar instead of blind-slicing a single QP's poll — the
+  // single-poll stall the sharded progress engine exists to kill.
+  // Backends whose completions are produced by their own threads
+  // (emu) call cq_pulse() at every CQ delivery; purely poll-driven
+  // backends (verbs) never pulse, and cq_wait degrades to a bounded
+  // sleep slice — correct, just not event-driven. The no-waiter fast
+  // path is one atomic add + one atomic load: the pulse rides every
+  // hot-path completion, so it must cost nothing when no shard is
+  // parked.
+  uint64_t cq_stamp() { return cq_stamp_.load(std::memory_order_acquire); }
+  void cq_pulse() {
+    cq_stamp_.fetch_add(1, std::memory_order_release);
+    if (cq_waiters_.load(std::memory_order_acquire) > 0) {
+      // Empty critical section: a waiter between its predicate check
+      // and its sleep holds cq_mu_, so taking it here orders this
+      // notify after that sleep — no missed wakeup.
+      { std::lock_guard<std::mutex> g(cq_mu_); }
+      cq_cv_.notify_all();
+    }
+  }
+  // Wait until the stamp moves past `seen` (true) or timeout (false).
+  bool cq_wait(uint64_t seen, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(cq_mu_);
+    cq_waiters_.fetch_add(1, std::memory_order_acq_rel);
+    bool moved = cq_cv_.wait_for(
+        lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return cq_stamp_.load(std::memory_order_acquire) != seen;
+        });
+    cq_waiters_.fetch_sub(1, std::memory_order_acq_rel);
+    return moved;
+  }
   // Live-QP accounting for multi-tenant engines (several concurrent
   // worlds sharing one engine under a budget). qp_limit 0 = unlimited.
   // Admission reserves a slot BEFORE the connection is attempted, so
@@ -170,6 +218,12 @@ class Engine {
   // step stamped into outbound seals and checked at land time. A
   // no-op on engines without sealing (verbs).
   virtual void set_seal_ctx(uint64_t /*gen_plus1*/, uint64_t /*step*/) {}
+
+ private:
+  std::mutex cq_mu_;
+  std::condition_variable cq_cv_;
+  std::atomic<uint64_t> cq_stamp_{0};
+  std::atomic<int> cq_waiters_{0};
 };
 
 Engine *create_emu_engine(std::string *err);
@@ -297,9 +351,23 @@ size_t copy_pool_workers();
 size_t fold_pool_workers();
 void fold_submit(std::function<void()> fn);
 // Registry counters: jobs executed and cumulative busy time — the
-// bench derives fold-offload occupancy (busy/wall) from these.
+// bench derives fold-offload occupancy (busy/wall) from these — plus
+// the instantaneous submitted-but-not-finished depth (diagnostics:
+// a deep queue with idle wire means the fold pool is the bottleneck).
 uint64_t fold_jobs();
 uint64_t fold_busy_us();
+uint64_t fold_pending();
+// Usable cores (affinity-mask truth; shared by every pool-sizing and
+// shard-sizing policy so they cannot disagree about the host).
+size_t usable_cores();
+
+// Sharded progress engine (ring_allreduce.cc): the resolved shard
+// count for a channel count (TDR_PROGRESS_SHARDS; 0 = legacy single
+// poll loop) and the progress.* registry counters — shard threads
+// launched, idle wakeups taken, completions consumed on shard
+// threads.
+size_t progress_shards_for(size_t channels);
+void progress_counters(uint64_t *shards, uint64_t *wakeups, uint64_t *wc);
 // Cumulative bytes moved via the streaming (non-temporal) vs cached
 // (memcpy) copy tiers — bench/diagnostic visibility into which path
 // carried the traffic.

@@ -33,17 +33,13 @@
 
 namespace tdr {
 
-namespace {
-
-// Slice granularity: big enough that per-slice dispatch cost vanishes,
-// small enough for dynamic balance across NUMA-variable memcpy speeds.
-constexpr size_t kGrain = 4u << 20;
-
 // Usable cores: the affinity mask (the container/cgroup truth) first,
 // hardware_concurrency as the fallback, 1 when both are dark. Shared
 // by every pool in this file — only the env override and clamp policy
-// differ per pool.
-size_t detect_cores() {
+// differ per pool — and by the progress-shard sizing policy
+// (ring_allreduce.cc), so pools and shards cannot disagree about the
+// host.
+size_t usable_cores() {
   cpu_set_t set;
   if (sched_getaffinity(0, sizeof(set), &set) == 0) {
     int n = CPU_COUNT(&set);
@@ -53,13 +49,19 @@ size_t detect_cores() {
   return hc ? hc : 1;
 }
 
+namespace {
+
+// Slice granularity: big enough that per-slice dispatch cost vanishes,
+// small enough for dynamic balance across NUMA-variable memcpy speeds.
+constexpr size_t kGrain = 4u << 20;
+
 size_t pool_threads() {
   const char *env = getenv("TDR_COPY_THREADS");
   if (env && *env) {
     long v = atol(env);
     if (v >= 1) return static_cast<size_t>(std::min(v, 64L));
   }
-  return std::min(detect_cores(), static_cast<size_t>(16));
+  return std::min(usable_cores(), static_cast<size_t>(16));
 }
 
 }  // namespace
@@ -306,7 +308,7 @@ size_t fold_threads() {
     long v = atol(env);
     if (v >= 0) return static_cast<size_t>(std::min(v, 16L));
   }
-  size_t n = detect_cores();
+  size_t n = usable_cores();
   // A 1-core host gains nothing from an offload thread (pure context-
   // switch tax); otherwise a small pool — the folds are memory-bound,
   // more workers than memory channels just thrash.
@@ -315,6 +317,12 @@ size_t fold_threads() {
 
 std::atomic<uint64_t> g_fold_jobs{0};
 std::atomic<uint64_t> g_fold_busy_us{0};
+// Submitted-but-not-finished depth: completion signaling back to the
+// submitter is the CLOSURE's job (the ring's fold jobs publish their
+// watermark and notify the schedule's condvar themselves); this gauge
+// is the pool-side view — sampled by diagnostics to tell "fold pool
+// is the bottleneck" (deep queue, idle wire) from the converse.
+std::atomic<uint64_t> g_fold_pending{0};
 
 class FoldPool {
  public:
@@ -328,6 +336,7 @@ class FoldPool {
   size_t workers() const { return nthreads_; }
 
   void submit(std::function<void()> fn) {
+    g_fold_pending.fetch_add(1, std::memory_order_relaxed);
     if (nthreads_ == 0) {
       run_one(fn);
       return;
@@ -349,6 +358,7 @@ class FoldPool {
     g_fold_jobs.fetch_add(1, std::memory_order_relaxed);
     g_fold_busy_us.fetch_add((tel_now_ns() - t0) / 1000,
                              std::memory_order_relaxed);
+    g_fold_pending.fetch_sub(1, std::memory_order_relaxed);
   }
 
   explicit FoldPool(size_t nthreads) : nthreads_(nthreads) {
@@ -390,6 +400,10 @@ uint64_t fold_jobs() {
 
 uint64_t fold_busy_us() {
   return g_fold_busy_us.load(std::memory_order_relaxed);
+}
+
+uint64_t fold_pending() {
+  return g_fold_pending.load(std::memory_order_relaxed);
 }
 
 void par_memcpy(void *dst, const void *src, size_t len) {

@@ -1221,6 +1221,7 @@ class EmuQp : public Qp {
       pending_.erase(it);
       cv_.notify_all();
       lk.unlock();
+      eng_->cq_pulse();
       tel_wc(wc.wr_id, wc.status, 0, post_ns);
     }
     set_error("post: connection down");
@@ -1286,10 +1287,16 @@ class EmuQp : public Qp {
     // may still be withheld behind an earlier ticket (posted-order
     // contract) — the timeline shows the truth, not the FIFO.
     tel_wc(wc.wr_id, wc.status, wc.len, r.post_ns);
-    std::lock_guard<std::mutex> g(mu_);
-    recv_done_[r.ticket] = wc;
-    drain_recv_done_locked();
-    cv_.notify_all();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      recv_done_[r.ticket] = wc;
+      drain_recv_done_locked();
+      cv_.notify_all();
+    }
+    // Engine-wide pulse AFTER the QP lock drops: a multi-QP waiter
+    // (progress shard) re-sweeps on the pulse and must find the
+    // completion already visible to tdr_poll.
+    eng_->cq_pulse();
   }
 
   void drain_recv_done_locked() {
@@ -1303,9 +1310,12 @@ class EmuQp : public Qp {
 
   void push_wc(tdr_wc wc) {
     tel_wc(wc.wr_id, wc.status, wc.len, 0);
-    std::lock_guard<std::mutex> g(mu_);
-    cq_.push_back(wc);
-    cv_.notify_all();
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      cq_.push_back(wc);
+      cv_.notify_all();
+    }
+    eng_->cq_pulse();
   }
 
   // Shared OP_SEND / OP_SEND_DESC skeleton, end to end: match the
@@ -2444,30 +2454,34 @@ class EmuQp : public Qp {
     // RC flush semantics (TDR_WC_FLUSH_ERR). Recv flushes route
     // through the ticket map so completions withheld behind a parked
     // (retransmit-pending) chunk drain in posted order.
-    std::lock_guard<std::mutex> g(mu_);
-    dead_ = true;
-    for (auto &kv : pending_) {
-      cq_.push_back({kv.second.wr_id, TDR_WC_FLUSH_ERR, kv.second.opcode, 0});
-      tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns);
-      release_pending_mr(kv.second.mr);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      dead_ = true;
+      for (auto &kv : pending_) {
+        cq_.push_back(
+            {kv.second.wr_id, TDR_WC_FLUSH_ERR, kv.second.opcode, 0});
+        tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns);
+        release_pending_mr(kv.second.mr);
+      }
+      pending_.clear();
+      for (auto &r : recvs_) {
+        recv_done_[r.ticket] = {r.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
+        tel_wc(r.wr_id, TDR_WC_FLUSH_ERR, 0, r.post_ns);
+        release_recv(r);
+      }
+      recvs_.clear();
+      for (auto &kv : parked_) {
+        recv_done_[kv.second.ticket] =
+            {kv.second.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
+        tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns);
+        release_recv(kv.second);
+      }
+      parked_.clear();
+      retx_attempts_.clear();
+      drain_recv_done_locked();
+      cv_.notify_all();
     }
-    pending_.clear();
-    for (auto &r : recvs_) {
-      recv_done_[r.ticket] = {r.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
-      tel_wc(r.wr_id, TDR_WC_FLUSH_ERR, 0, r.post_ns);
-      release_recv(r);
-    }
-    recvs_.clear();
-    for (auto &kv : parked_) {
-      recv_done_[kv.second.ticket] =
-          {kv.second.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
-      tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns);
-      release_recv(kv.second);
-    }
-    parked_.clear();
-    retx_attempts_.clear();
-    drain_recv_done_locked();
-    cv_.notify_all();
+    eng_->cq_pulse();
   }
 
   void complete_pending(uint64_t seq, uint8_t status, char *, uint64_t) {
@@ -2481,6 +2495,7 @@ class EmuQp : public Qp {
     pending_.erase(it);
     cv_.notify_all();
     lk.unlock();
+    eng_->cq_pulse();
     tel_wc(wc.wr_id, wc.status, wc.len, post_ns);
   }
 

@@ -35,8 +35,10 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <functional>
 #include <mutex>
+#include <thread>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
@@ -118,6 +120,12 @@ constexpr uint64_t kWrKindMask = 0xffffull << 48;
 // ring_begin/ring_end pair up in the exported timeline.
 std::atomic<uint64_t> g_ring_call_seq{0};
 
+// Sharded progress engine accounting (registry progress.*): shard
+// threads launched, idle wakeups, completions consumed on shards.
+std::atomic<uint64_t> g_prog_shards{0};
+std::atomic<uint64_t> g_prog_wakeups{0};
+std::atomic<uint64_t> g_prog_wc{0};
+
 // Bracket one collective call: RING_BEGIN/RING_END events plus the
 // whole-collective latency and bandwidth histograms. Zero-cost when
 // telemetry is off (the ctor takes the one-branch guard and leaves
@@ -150,6 +158,41 @@ struct RingTelScope {
 };
 
 }  // namespace
+
+namespace tdr {
+
+// Resolved progress-shard count for a `channels`-channel ring
+// (TDR_PROGRESS_SHARDS). 0 = the legacy single-poll loop. Default:
+// one shard per channel — the DMA-streaming model of one progress
+// engine per buffer chain — capped at the host's usable cores, and 0
+// on a 1-core host: shards win by polling in parallel with posting,
+// and a single core can only interleave them with context switches
+// (measured 5-10% WORSE than the inline loop — the same 1-core rule
+// the fold pool applies). Per-PROCESS execution strategy, never
+// negotiated and never in the schedule digest: any mix of shard
+// counts across ranks is wire-compatible and bitwise-identical.
+// Parsed per collective (getenv is nanoseconds next to an MB-scale
+// collective) so tests may flip the knob between worlds.
+size_t progress_shards_for(size_t channels) {
+  if (channels < 1) channels = 1;
+  const char *env = getenv("TDR_PROGRESS_SHARDS");
+  if (env && *env) {
+    long v = atol(env);
+    if (v <= 0) return 0;
+    return std::min(static_cast<size_t>(v), channels);
+  }
+  size_t cores = usable_cores();
+  if (cores <= 1) return 0;
+  return std::min(channels, cores);
+}
+
+void progress_counters(uint64_t *shards, uint64_t *wakeups, uint64_t *wc) {
+  if (shards) *shards = g_prog_shards.load(std::memory_order_relaxed);
+  if (wakeups) *wakeups = g_prog_wakeups.load(std::memory_order_relaxed);
+  if (wc) *wc = g_prog_wc.load(std::memory_order_relaxed);
+}
+
+}  // namespace tdr
 
 struct tdr_ring {
   tdr_engine *eng;
@@ -361,14 +404,31 @@ extern "C++" {
 namespace {
 
 // ------------------------------------------------------------------
-// Multi-channel completion plumbing shared by the striped schedules.
-// A schedule exposes `int on_wc(bool left_side, size_t chan, const
-// tdr_wc &wc)` plus `void owed_channel(bool*, size_t*)`; sweep_side()
-// drains every channel of one side without blocking, and wait_owed()
-// parks a bounded slice on the channel owed the oldest outstanding
-// completion so blocking happens where the critical path advances and
-// a stall on any channel still honors the ring deadline.
+// Progress plumbing shared by the striped schedules.
+//
+// A schedule exposes a THREAD-SAFE `int on_wc(bool left_side, size_t
+// chan, const tdr_wc &wc)` (per-channel FIFO counters under the hub's
+// per-channel locks, cross-channel watermarks/masks under the hub
+// mutex) plus `post_more()`, `finished_locked()`, `owed_channel()`,
+// and `stall_detail()`. Two drivers consume that surface:
+//
+//  - run_* legacy loop (TDR_PROGRESS_SHARDS=0): the calling thread
+//    owns all polling — sweep_side() drains every channel without
+//    blocking, wait_owed() parks a bounded slice on the channel owed
+//    the oldest outstanding completion. One thread, one blocking
+//    poll: wire progress on channel A can wait out a park owed to
+//    channel B (the BENCH_r06 vs_bound gap).
+//
+//  - drive_sharded() (default): TDR_PROGRESS_SHARDS dedicated
+//    progress threads, each polling ONLY its channel group's QPs and
+//    publishing completion watermarks through on_wc; the schedule's
+//    calling thread becomes a pure consumer — it posts what the
+//    watermarks allow and sleeps on the hub's ONE condvar, which
+//    every completion, fold, and failure notifies. No channel's
+//    progress ever waits behind a blocking poll owed to another.
 // ------------------------------------------------------------------
+
+constexpr int kShardSliceMs = 2;  // shard park bound (verbs has no pulse)
 
 template <typename S>
 int sweep_side(const std::vector<tdr_qp *> &qps, S &sched, bool left) {
@@ -409,15 +469,97 @@ int wait_owed(tdr_ring *r, S &sched, int slice_ms) {
   return n;
 }
 
+// Watermark hub: the schedules' shared done-mask state. Fine-grained
+// per-channel locks guard each channel's FIFO counters (single
+// writer: the shard owning the channel — or the one polling thread in
+// legacy mode); the hub mutex guards the cross-channel aggregates,
+// masks, in-order-prefix frontiers, and fold bookkeeping; the ONE
+// condvar carries every watermark publication. Lock discipline:
+// chan_mu[c] and mu are never held together.
+struct ProgressHub {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::mutex> chan_mu;
+  std::atomic<bool> stop{false};
+  bool failed = false;   // under mu
+  std::string err;       // under mu (thread-local errors bridged here:
+                         // a shard's set_error is invisible to the
+                         // posting thread's tdr_last_error slot)
+  uint64_t stamp = 0;    // watermark publication count, under mu
+
+  void init(size_t nc) {
+    while (chan_mu.size() < nc) chan_mu.emplace_back();
+    std::lock_guard<std::mutex> g(mu);
+    failed = false;
+    err.clear();
+    stop.store(false, std::memory_order_relaxed);
+  }
+  void bump_locked() {
+    stamp++;
+    cv.notify_all();
+  }
+  void fail(const std::string &msg) {
+    std::lock_guard<std::mutex> g(mu);
+    if (!failed) {
+      failed = true;
+      err = msg;
+    }
+    bump_locked();
+  }
+};
+
+// Error helpers: record in the calling thread's error slot AND the
+// hub (on_wc may run on a shard thread whose thread-local error the
+// posting thread can never read).
+int wc_fail(ProgressHub &hub, const char *label, const tdr_wc &wc) {
+  std::string msg = std::string(label) + ": completion error status " +
+                    wc_status_label(wc.status);
+  tdr::set_error(msg);
+  hub.fail(msg);
+  return -1;
+}
+
+int order_fail(ProgressHub &hub, const char *label, const char *what,
+               size_t chan) {
+  std::string msg = std::string(label) + ": " + what + " on channel " +
+                    std::to_string(chan);
+  tdr::set_error(msg);
+  hub.fail(msg);
+  return -1;
+}
+
+// Shared stall-deadline bookkeeping (factored from the schedules'
+// previously-duplicated poll-timeout blocks): the deadline re-arms on
+// ANY progress; expiry produces one labeled error whose detail names
+// the owed channel/watermark, so a stall report says WHERE the
+// schedule is blocked, not just that it is.
+struct StallClock {
+  std::chrono::steady_clock::time_point dl;
+  StallClock() { bump(); }
+  void bump() {
+    dl = std::chrono::steady_clock::now() +
+         std::chrono::milliseconds(ring_timeout_ms());
+  }
+  bool expired() const { return std::chrono::steady_clock::now() >= dl; }
+};
+
+int stall_fail(const char *label, const std::string &detail) {
+  tdr::set_error(std::string(label) + ": poll timeout (" + detail + ")");
+  return -1;
+}
+
 // Channel holding the oldest outstanding item of one striped stream:
 // per-channel FIFO means channel c's next completion is index
 // c + done[c]*nc, so the argmin over channels with posted > done IS
-// the stream's oldest outstanding chunk. SIZE_MAX when none.
-inline size_t oldest_outstanding(const std::vector<size_t> &posted,
+// the stream's oldest outstanding chunk. SIZE_MAX when none. Reads
+// each channel's counters under its own lock.
+inline size_t oldest_outstanding(ProgressHub &hub,
+                                 const std::vector<size_t> &posted,
                                  const std::vector<size_t> &done,
                                  size_t nc, size_t *chan) {
   size_t best = static_cast<size_t>(-1);
   for (size_t c = 0; c < nc; c++) {
+    std::lock_guard<std::mutex> g(hub.chan_mu[c]);
     if (posted[c] <= done[c]) continue;
     size_t idx = c + done[c] * nc;
     if (idx < best) {
@@ -426,6 +568,134 @@ inline size_t oldest_outstanding(const std::vector<size_t> &posted,
     }
   }
   return best;
+}
+
+// One run's progress shards: shard s owns channels {s, s+n, ...} of
+// both sides (each QP has exactly one poller), feeding completions
+// through the schedule's thread-safe on_wc and — for the windowed
+// schedule — enqueuing folds onto the fold pool straight from the
+// shard thread. When its channels are idle the shard parks on the
+// ENGINE's completion pulse: event-driven on emu (every CQ delivery
+// pulses), a bounded kShardSliceMs slice on verbs.
+template <typename S>
+class ShardCrew {
+ public:
+  ShardCrew(tdr_ring *r, S *sched, ProgressHub *hub, size_t nshards,
+            bool two_sides)
+      : hub_(hub) {
+    size_t nc = r->lefts.size();
+    if (nshards > nc) nshards = nc;
+    g_prog_shards.fetch_add(nshards, std::memory_order_relaxed);
+    for (size_t s = 0; s < nshards; s++) {
+      std::vector<Owned> own;
+      for (size_t c = s; c < nc; c += nshards) {
+        own.push_back({r->lefts[c], true, c});
+        if (two_sides) own.push_back({r->rights[c], false, c});
+      }
+      threads_.emplace_back(
+          [this, r, sched, own = std::move(own), s] {
+            loop(r, sched, own, s);
+          });
+    }
+  }
+  ~ShardCrew() {
+    hub_->stop.store(true, std::memory_order_release);
+    for (auto &t : threads_) t.join();  // park is kShardSliceMs-bounded
+  }
+
+ private:
+  struct Owned {
+    tdr_qp *qp;
+    bool left;
+    size_t chan;
+  };
+
+  void loop(tdr_ring *r, S *sched, const std::vector<Owned> &own,
+            size_t ordinal) {
+    auto *eng = reinterpret_cast<tdr::Engine *>(r->eng);
+    tdr_wc wc[16];
+    uint64_t consumed = 0;
+    while (!hub_->stop.load(std::memory_order_acquire)) {
+      // Stamp BEFORE the sweep: a completion landing mid-sweep on an
+      // already-swept QP moves the stamp, so the wait below returns
+      // immediately instead of sleeping on work that already arrived.
+      uint64_t seen = eng->cq_stamp();
+      int got = 0;
+      for (const Owned &o : own) {
+        for (;;) {
+          int n = tdr_poll(o.qp, wc, 16, 0);
+          if (n < 0) {
+            hub_->fail(std::string("ring progress shard: ") +
+                       tdr::get_error());
+            return;
+          }
+          for (int i = 0; i < n; i++)
+            if (sched->on_wc(o.left, o.chan, wc[i]) != 0) return;
+          got += n;
+          if (n < 16) break;
+        }
+      }
+      if (got > 0) {
+        consumed += static_cast<uint64_t>(got);
+        g_prog_wc.fetch_add(static_cast<uint64_t>(got),
+                            std::memory_order_relaxed);
+        // Process-level lane (engine=0, like the copy pool's events):
+        // drain-batch boundaries ride thread timing and must not
+        // perturb per-engine replay shapes.
+        TDR_TEL(TDR_TEL_SHARD, 0, tdr::tel_thread_track(), ordinal,
+                consumed);
+        continue;
+      }
+      g_prog_wakeups.fetch_add(1, std::memory_order_relaxed);
+      eng->cq_wait(seen, kShardSliceMs);
+    }
+  }
+
+  ProgressHub *hub_;
+  std::vector<std::thread> threads_;
+};
+
+// Watermark-consumer driver (sharded mode): posting stays on the
+// calling thread; polling lives on the shards. The loop body is the
+// whole schedule now — post what the watermarks allow, then sleep on
+// the hub condvar until they move. The special-case idle states the
+// legacy loops carry (fold-only wait, wire-idle-but-fold-gated)
+// collapse into the one wait because folds publish on the same cv.
+template <typename S>
+int drive_sharded(tdr_ring *r, S &s, ProgressHub &hub, size_t nshards,
+                  bool two_sides, const char *label) {
+  ShardCrew<S> crew(r, &s, &hub, nshards, two_sides);
+  StallClock clock;
+  for (;;) {
+    {
+      std::lock_guard<std::mutex> g(hub.mu);
+      if (hub.failed) {
+        tdr::set_error(hub.err);
+        return -1;
+      }
+      if (s.finished_locked()) return 0;
+    }
+    int p = s.post_more();
+    if (p < 0) return -1;
+    if (p > 0) {
+      clock.bump();
+      continue;
+    }
+    bool moved;
+    {
+      std::unique_lock<std::mutex> lk(hub.mu);
+      uint64_t seen = hub.stamp;
+      hub.cv.wait_for(lk, std::chrono::milliseconds(50), [&] {
+        return hub.stamp != seen || hub.failed;
+      });
+      moved = hub.stamp != seen || hub.failed;
+    }
+    if (moved) {
+      clock.bump();
+      continue;
+    }
+    if (clock.expired()) return stall_fail(label, s.stall_detail());
+  }
 }
 
 struct StepPipe {
@@ -445,18 +715,23 @@ struct StepPipe {
   size_t n_send = 0, n_recv = 0;
   bool fused = false, windowed = false;
   size_t slots = 0, slot_bytes = 0;
-  size_t posted_r = 0, done_r = 0, posted_s = 0, acked_s = 0;
+  // Posting cursors: single-writer (the posting thread).
+  size_t posted_r = 0, posted_s = 0;
+  // Completion watermarks. The per-channel FIFO counters live under
+  // hub.chan_mu[c] (single writer: the shard owning channel c, or the
+  // one polling thread in legacy mode); the cross-channel aggregates
+  // and fold bookkeeping live under hub.mu.
   std::vector<size_t> posted_rc, done_rc, posted_sc, acked_sc;
+  size_t done_r = 0, acked_s = 0;      // under hub.mu
   std::vector<size_t> rwin_c, swin_c;  // per-channel window budgets
-  bool bad = false;  // an on_wc error was recorded
 
   // Async fold tracking (windowed mode). fold_done gates scratch-slot
   // reuse: recv for chunk i may repost only once chunk i-slots has
-  // FOLDED (not merely landed) — the slot is its fold's source.
+  // FOLDED (not merely landed) — the slot is its fold's source. All
+  // under hub.mu; fold completions publish on the hub condvar.
   bool offload = false;
   uint16_t eng_tel = 0;
-  std::mutex fmu;
-  std::condition_variable fcv;
+  ProgressHub hub;
   std::vector<uint8_t> fold_done;
   size_t folds_out = 0;  // submitted to the pool, not yet finished
   size_t folded = 0;     // chunks whose fold completed (any path)
@@ -473,17 +748,17 @@ struct StepPipe {
     tdr::reduce_any(cdata + recv_off_ + idx * chunk,
                     r->tmp.data() + (idx % slots) * slot_bytes, len / esz,
                     dtype, red_op);
-    TDR_TEL(TDR_TEL_FOLD, eng_tel, 0, idx, len);
-    std::lock_guard<std::mutex> g(fmu);
+    TDR_TEL(TDR_TEL_FOLD, eng_tel, tdr::tel_thread_track(), idx, len);
+    std::lock_guard<std::mutex> g(hub.mu);
     fold_done[idx] = 1;
     folded++;
     folds_out--;
-    fcv.notify_all();
+    hub.bump_locked();
   }
 
   bool fold_ready(size_t i) {
     if (!windowed || i < slots) return true;
-    std::lock_guard<std::mutex> g(fmu);
+    std::lock_guard<std::mutex> g(hub.mu);
     return fold_done[i - slots] != 0;
   }
 
@@ -500,7 +775,10 @@ struct StepPipe {
                          (i % slots) * slot_bytes, len, kWrRecv | i);
     else
       rc = tdr_post_recv(qp, dmr, recv_off_ + i * chunk, len, kWrRecv | i);
-    if (rc == 0) posted_rc[c]++;
+    if (rc == 0) {
+      std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+      posted_rc[c]++;
+    }
     return rc;
   }
 
@@ -510,13 +788,14 @@ struct StepPipe {
   // ack.
   void owed_channel(bool *left, size_t *chan) {
     size_t c = 0;
-    if (oldest_outstanding(posted_rc, done_rc, nc, &c) !=
+    if (oldest_outstanding(hub, posted_rc, done_rc, nc, &c) !=
         static_cast<size_t>(-1)) {
       *left = true;
       *chan = c;
       return;
     }
     for (size_t i = 0; i < nc; i++) {
+      std::lock_guard<std::mutex> g(hub.chan_mu[i]);
       if (posted_sc[i] > acked_sc[i]) {
         *left = false;
         *chan = i;
@@ -529,50 +808,136 @@ struct StepPipe {
 
   int on_wc(bool left, size_t chan, const tdr_wc &wc) {
     (void)left;
-    if (wc.status != TDR_WC_SUCCESS) {
-      tdr::set_error("ring: completion error status " +
-                     wc_status_label(wc.status));
-      bad = true;
-      return -1;
-    }
+    if (wc.status != TDR_WC_SUCCESS) return wc_fail(hub, "ring", wc);
     uint64_t kind = wc.wr_id & kWrKindMask;
     size_t idx = wc.wr_id & ~kWrKindMask;
     if (kind == kWrSend) {
-      acked_s++;
-      acked_sc[idx % nc]++;
-    } else if (kind == kWrRecv) {
-      // Per-channel FIFO: channel c carries chunks c, c+nc, c+2nc, …
-      // in posted order; cross-channel arrival order is free.
-      if (idx != chan + done_rc[chan] * nc) {
-        tdr::set_error("ring: out-of-order recv completion on channel " +
-                       std::to_string(chan));
-        bad = true;
-        return -1;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[idx % nc]);
+        acked_sc[idx % nc]++;
       }
+      std::lock_guard<std::mutex> g(hub.mu);
+      acked_s++;
+      hub.bump_locked();
+      return 0;
+    }
+    if (kind != kWrRecv) return 0;
+    // Per-channel FIFO: channel c carries chunks c, c+nc, c+2nc, …
+    // in posted order; cross-channel arrival order is free.
+    {
+      std::lock_guard<std::mutex> g(hub.chan_mu[chan]);
+      if (idx != chan + done_rc[chan] * nc)
+        goto out_of_order;
       done_rc[chan]++;
+    }
+    if (!windowed) {
+      std::lock_guard<std::mutex> g(hub.mu);
       done_r++;
-      if (windowed) {
-        size_t len = chunk_len(recv_len_, idx);
-        if (offload) {
-          {
-            std::lock_guard<std::mutex> g(fmu);
-            folds_out++;
-          }
-          TDR_TEL(TDR_TEL_FOLD_OFF, eng_tel, 0, idx, len);
-          tdr::fold_submit([this, idx] { fold_chunk(idx); });
-        } else {
-          // Inline fallback (no fold workers): the legacy path, with
-          // the copy pool forking the fold itself.
-          tdr::par_reduce(cdata + recv_off_ + idx * chunk,
-                          r->tmp.data() + (idx % slots) * slot_bytes,
-                          len / esz, dtype, red_op);
-          std::lock_guard<std::mutex> g(fmu);
-          fold_done[idx] = 1;
-          folded++;
+      hub.bump_locked();
+      return 0;
+    }
+    {
+      size_t len = chunk_len(recv_len_, idx);
+      if (offload) {
+        {
+          std::lock_guard<std::mutex> g(hub.mu);
+          done_r++;
+          folds_out++;
+          hub.bump_locked();
         }
+        // Fold enqueued straight from the progress (shard) thread;
+        // the job publishes its watermark back on the hub condvar.
+        TDR_TEL(TDR_TEL_FOLD_OFF, eng_tel, tdr::tel_thread_track(), idx,
+                len);
+        tdr::fold_submit([this, idx] { fold_chunk(idx); });
+      } else {
+        // Inline fallback (no fold workers): the legacy path, with
+        // the copy pool forking the fold itself.
+        tdr::par_reduce(cdata + recv_off_ + idx * chunk,
+                        r->tmp.data() + (idx % slots) * slot_bytes,
+                        len / esz, dtype, red_op);
+        std::lock_guard<std::mutex> g(hub.mu);
+        done_r++;
+        fold_done[idx] = 1;
+        folded++;
+        hub.bump_locked();
       }
     }
     return 0;
+  out_of_order:
+    return order_fail(hub, "ring", "out-of-order recv completion", chan);
+  }
+
+  bool finished_locked() const {
+    return done_r == n_recv && acked_s == n_send &&
+           (!windowed || folded == n_recv);
+  }
+
+  std::string stall_detail() {
+    bool left = true;
+    size_t chan = 0;
+    owed_channel(&left, &chan);
+    size_t dr, as, fo;
+    {
+      std::lock_guard<std::mutex> g(hub.mu);
+      dr = done_r;
+      as = acked_s;
+      fo = folded;
+    }
+    std::string d = std::string("owed ") + (left ? "recv" : "send-ack") +
+                    " on channel " + std::to_string(chan) + "; s " +
+                    std::to_string(as) + "/" + std::to_string(n_send) +
+                    " r " + std::to_string(dr) + "/" +
+                    std::to_string(n_recv);
+    if (windowed)
+      d += " folded " + std::to_string(fo) + "/" + std::to_string(n_recv);
+    return d;
+  }
+
+  // Posting side, shared by both drivers: post whatever the windows
+  // (and, windowed, the fold watermarks) allow, strictly in global
+  // chunk order — which IS per-channel posted order, all FIFO
+  // matching needs. Returns progress, or -1.
+  int post_more() {
+    bool progressed = false;
+    while (posted_r < n_recv) {
+      size_t c = posted_r % nc;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+        if (posted_rc[c] - done_rc[c] >= rwin_c[c]) break;
+      }
+      if (windowed && !fold_ready(posted_r)) break;
+      if (post_recv_chunk(posted_r) != 0) return -1;
+      posted_r++;
+      progressed = true;
+    }
+    // Keep outbound traffic moving: in stream mode the post blocks
+    // while the chunk drains into the socket (the progress threads
+    // land inbound chunks concurrently); in CMA mode it just queues a
+    // descriptor. The windowed throttle tracks LANDED chunks (the
+    // peer's symmetric scratch window), not folds.
+    while (posted_s < n_send) {
+      size_t c = posted_s % nc;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+        if (posted_sc[c] - acked_sc[c] >= swin_c[c]) break;
+      }
+      if (windowed && n_recv) {
+        std::lock_guard<std::mutex> g(hub.mu);
+        if (posted_s >= done_r + slots) break;
+      }
+      size_t len = chunk_len(send_len_, posted_s);
+      if (tdr_post_send(r->rights[c], dmr, send_off_ + posted_s * chunk,
+                        len, kWrSend | posted_s) != 0)
+        return -1;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+        posted_sc[c]++;
+      }
+      posted_s++;
+      progressed = true;
+    }
+    return progressed ? 1 : 0;
   }
 
   // One neighbor-exchange step: stream `send_len` bytes of the data
@@ -610,27 +975,31 @@ struct StepPipe {
     slot_bytes = windowed ? std::min(chunk, recv_len ? recv_len : 1) : 0;
     if (windowed && n_recv && !r->scratch(slots * slot_bytes)) return -1;
 
-    posted_r = done_r = posted_s = acked_s = 0;
+    posted_r = posted_s = 0;
     posted_rc.assign(nc, 0);
     done_rc.assign(nc, 0);
     posted_sc.assign(nc, 0);
     acked_sc.assign(nc, 0);
-    bad = false;
     offload = windowed && tdr::fold_pool_workers() > 0;
     eng_tel = reinterpret_cast<tdr::Engine *>(r->eng)->tel_id;
+    hub.init(nc);
     {
-      std::lock_guard<std::mutex> g(fmu);
+      std::lock_guard<std::mutex> g(hub.mu);
+      done_r = acked_s = 0;
       fold_done.assign(windowed ? n_recv : 0, 0);
       folds_out = 0;
       folded = 0;
     }
     // Whatever happens below, never return while a fold job still
-    // references the scratch window or the data buffer.
+    // references the scratch window or the data buffer. Declared
+    // FIRST so it drains AFTER the sharded driver has joined its
+    // shard threads (destructors run in reverse order) — no shard can
+    // submit a fold once the drain starts counting.
     struct FoldDrain {
       StepPipe *p;
       ~FoldDrain() {
-        std::unique_lock<std::mutex> lk(p->fmu);
-        p->fcv.wait(lk, [&] { return p->folds_out == 0; });
+        std::unique_lock<std::mutex> lk(p->hub.mu);
+        p->hub.cv.wait(lk, [&] { return p->folds_out == 0; });
       }
     } fold_drain{this};
     (void)fold_drain;
@@ -648,53 +1017,27 @@ struct StepPipe {
     }
 
     const bool same_qp = (r->lefts[0] == r->rights[0]);
+    const size_t shards = tdr::progress_shards_for(nc);
+    // Tiny runs (a barrier's one chunk, a short tail segment) post
+    // and finish faster than a shard thread spawns: keep them on the
+    // legacy inline loop regardless of the knob.
+    if (shards > 0 && n_recv + n_send >= 4)
+      return drive_sharded(r, *this, hub, shards, !same_qp, "ring");
+    return run_polled(same_qp);
+  }
 
-    // Post whatever the windows allow, strictly in global chunk order
-    // (which IS per-channel posted order — FIFO matching needs nothing
-    // more). Returns progress, or -1.
-    auto post_more = [&]() -> int {
-      bool progressed = false;
-      while (posted_r < n_recv) {
-        size_t c = posted_r % nc;
-        if (posted_rc[c] - done_rc[c] >= rwin_c[c]) break;
-        if (windowed && !fold_ready(posted_r)) break;
-        if (post_recv_chunk(posted_r) != 0) return -1;
-        posted_r++;
-        progressed = true;
-      }
-      // Keep outbound traffic moving: in stream mode the post blocks
-      // while the chunk drains into the socket (the progress thread
-      // lands inbound chunks concurrently); in CMA mode it just
-      // queues a descriptor. The windowed throttle tracks LANDED
-      // chunks (the peer's symmetric scratch window), not folds.
-      while (posted_s < n_send) {
-        size_t c = posted_s % nc;
-        if (posted_sc[c] - acked_sc[c] >= swin_c[c]) break;
-        if (windowed && n_recv && posted_s >= done_r + slots) break;
-        size_t len = chunk_len(send_len_, posted_s);
-        if (tdr_post_send(r->rights[c], dmr, send_off_ + posted_s * chunk,
-                          len, kWrSend | posted_s) != 0)
-          return -1;
-        posted_sc[c]++;
-        posted_s++;
-        progressed = true;
-      }
-      return progressed ? 1 : 0;
-    };
-
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(ring_timeout_ms());
+  // Legacy single-poll loop (TDR_PROGRESS_SHARDS=0, and tiny runs):
+  // the calling thread owns all polling and folds gate its waits.
+  int run_polled(bool same_qp) {
+    StallClock clock;
     size_t last_folded = 0;
     for (;;) {
       {
-        std::lock_guard<std::mutex> g(fmu);
-        if (done_r == n_recv && acked_s == n_send &&
-            (!windowed || folded == n_recv))
-          break;
+        std::lock_guard<std::mutex> g(hub.mu);
+        if (finished_locked()) break;
         if (folded != last_folded) {
           last_folded = folded;
-          deadline = std::chrono::steady_clock::now() +
-                     std::chrono::milliseconds(ring_timeout_ms());
+          clock.bump();
         }
       }
       int p = post_more();
@@ -704,36 +1047,36 @@ struct StepPipe {
       int nr = same_qp ? 0 : sweep_side(r->rights, *this, false);
       if (nr < 0) return -1;
       if (p > 0 || nl > 0 || nr > 0) {
-        deadline = std::chrono::steady_clock::now() +
-                   std::chrono::milliseconds(ring_timeout_ms());
+        clock.bump();
         continue;
       }
-      if (done_r == n_recv && acked_s == n_send) {
+      size_t dr, as;
+      {
+        std::lock_guard<std::mutex> g(hub.mu);
+        dr = done_r;
+        as = acked_s;
+      }
+      if (dr == n_recv && as == n_send) {
         // Only folds left: they are pure local CPU work — wait on the
-        // fold cv, not the wire.
-        std::unique_lock<std::mutex> lk(fmu);
-        fcv.wait(lk, [&] { return folded == n_recv; });
+        // hub cv (fold completions publish there), not the wire.
+        std::unique_lock<std::mutex> lk(hub.mu);
+        hub.cv.wait(lk, [&] { return folded == n_recv; });
         continue;
       }
       // Wire idle but fold-gated (every posted recv landed, every
       // send acked, posting blocked on scratch slots): the only
       // possible progress is offloaded folds, and a fold completion
-      // signals fcv — a QP poll would just sleep its full slice.
-      if (windowed && posted_r == done_r && posted_s == acked_s) {
+      // notifies the hub cv — a QP poll would just sleep its slice.
+      if (windowed && posted_r == dr && posted_s == as) {
         bool fold_moved;
         {
-          std::unique_lock<std::mutex> lk(fmu);
-          fcv.wait_for(lk, std::chrono::milliseconds(50),
-                       [&] { return folded != last_folded; });
+          std::unique_lock<std::mutex> lk(hub.mu);
+          hub.cv.wait_for(lk, std::chrono::milliseconds(50),
+                          [&] { return folded != last_folded; });
           fold_moved = folded != last_folded;
         }
-        if (!fold_moved && std::chrono::steady_clock::now() >= deadline) {
-          tdr::set_error("ring: fold stall (s " + std::to_string(acked_s) +
-                         "/" + std::to_string(n_send) + " r " +
-                         std::to_string(done_r) + "/" +
-                         std::to_string(n_recv) + ")");
-          return -1;
-        }
+        if (!fold_moved && clock.expired())
+          return stall_fail("ring", "fold stall; " + stall_detail());
         continue;
       }
       // Nothing postable, nothing completed: block a slice on the
@@ -743,22 +1086,16 @@ struct StepPipe {
       int n = wait_owed(r, *this, 50);
       if (n < 0) return -1;
       if (n > 0) {
-        deadline = std::chrono::steady_clock::now() +
-                   std::chrono::milliseconds(ring_timeout_ms());
+        clock.bump();
         continue;
       }
       bool fold_moved;
       {
-        std::lock_guard<std::mutex> g(fmu);
+        std::lock_guard<std::mutex> g(hub.mu);
         fold_moved = folded != last_folded;
       }
-      if (!fold_moved && std::chrono::steady_clock::now() >= deadline) {
-        tdr::set_error("ring: poll timeout (s " + std::to_string(acked_s) +
-                       "/" + std::to_string(n_send) + " r " +
-                       std::to_string(done_r) + "/" +
-                       std::to_string(n_recv) + ")");
-        return -1;
-      }
+      if (!fold_moved && clock.expired())
+        return stall_fail("ring", stall_detail());
     }
     return 0;
   }
@@ -803,22 +1140,27 @@ struct FusedTwo {
 
   // Stream bookkeeping, striped chunk i → channel i % nc. Recv
   // completions may arrive out of GLOBAL order across channels (per
-  // channel they stay FIFO — asserted via the per-channel counters),
-  // so both inbound streams keep done-masks; the B stream also keeps
-  // the in-order folded PREFIX (fr_rB) because returning reduced
-  // chunk k to the peer requires k's fold complete AND FIFO order on
-  // the left channel k % nc.
+  // channel they stay FIFO — asserted via the per-channel counters,
+  // which live under the hub's per-channel locks), so both inbound
+  // streams keep done-masks; the B stream also keeps the in-order
+  // folded PREFIX (fr_rB) because returning reduced chunk k to the
+  // peer requires k's fold complete AND FIFO order on the left
+  // channel k % nc. Masks, prefixes, and aggregates live under
+  // hub.mu — the in-order-prefix dependency state the one condvar
+  // publishes.
   size_t nc = 1;
-  size_t posted_rB = 0, done_rB = 0;   // left in: B chunks to fold
-  size_t posted_sB = 0, acked_sB = 0;  // left out: reduced B chunks
-  size_t posted_sA = 0, acked_sA = 0;  // right out: A chunks
-  size_t posted_rA = 0, done_rA = 0;   // right in: reduced A chunks
-  std::vector<uint8_t> mask_rB, mask_rA;
-  size_t fr_rB = 0;  // in-order folded prefix of the B stream
+  size_t posted_rB = 0, posted_sB = 0;  // posting cursors (one writer)
+  size_t posted_sA = 0, posted_rA = 0;
+  size_t done_rB = 0, acked_sB = 0;     // aggregates, under hub.mu
+  size_t acked_sA = 0, done_rA = 0;
+  size_t need_sB = 0;
+  std::vector<uint8_t> mask_rB, mask_rA;           // under hub.mu
+  size_t fr_rB = 0;  // in-order folded prefix, under hub.mu
   std::vector<size_t> done_rBc, done_rAc;      // per-channel order check
   std::vector<size_t> pc_rB, pc_rA, pc_sA, ac_sA;  // per-channel windows
   std::vector<size_t> pc_sB, ac_sB;  // per-channel sB accounting
   std::vector<size_t> rb_win, sa_win;
+  ProgressHub hub;
 
   static size_t nchunks(size_t len, size_t chunk) {
     return len ? (len + chunk - 1) / chunk : 0;
@@ -831,51 +1173,66 @@ struct FusedTwo {
     int rc = tdr_post_recv_reduce(r->lefts[i % nc], dmr, b_off + i * chunk,
                                   clen(b_len, i), dtype, red_op,
                                   kWrRecv | i);
-    if (rc == 0) pc_rB[i % nc]++;
+    if (rc == 0) {
+      std::lock_guard<std::mutex> g(hub.chan_mu[i % nc]);
+      pc_rB[i % nc]++;
+    }
     return rc;
   }
   int post_recv_a(size_t i) {
     int rc = tdr_post_recv(r->rights[i % nc], dmr, a_off + i * chunk,
                            clen(a_len, i), kWrRecv | i);
-    if (rc == 0) pc_rA[i % nc]++;
+    if (rc == 0) {
+      std::lock_guard<std::mutex> g(hub.chan_mu[i % nc]);
+      pc_rA[i % nc]++;
+    }
     return rc;
   }
 
   int on_wc(bool left, size_t chan, const tdr_wc &wc) {
-    if (wc.status != TDR_WC_SUCCESS) {
-      tdr::set_error("ring(fused2): completion error status " +
-                     wc_status_label(wc.status));
-      return -1;
-    }
+    if (wc.status != TDR_WC_SUCCESS)
+      return wc_fail(hub, "ring(fused2)", wc);
     uint64_t kind = wc.wr_id & kWrKindMask;
     size_t idx = wc.wr_id & ~kWrKindMask;
     if (kind == kWrSend) {
-      if (left) {
-        acked_sB++;
-        ac_sB[idx % nc]++;
-      } else {
-        acked_sA++;
-        ac_sA[idx % nc]++;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[idx % nc]);
+        (left ? ac_sB : ac_sA)[idx % nc]++;
       }
+      std::lock_guard<std::mutex> g(hub.mu);
+      (left ? acked_sB : acked_sA)++;
+      hub.bump_locked();
       return 0;
     }
     if (kind != kWrRecv) return 0;
-    std::vector<size_t> &done_c = left ? done_rBc : done_rAc;
-    std::vector<uint8_t> &mask = left ? mask_rB : mask_rA;
-    if (idx >= mask.size() || mask[idx] ||
-        idx != chan + done_c[chan] * nc) {
-      tdr::set_error("ring(fused2): out-of-order recv completion on "
-                     "channel " + std::to_string(chan));
-      return -1;
+    bool ooo = false;
+    {
+      std::lock_guard<std::mutex> g(hub.chan_mu[chan]);
+      std::vector<size_t> &done_c = left ? done_rBc : done_rAc;
+      if (idx != chan + done_c[chan] * nc)
+        ooo = true;
+      else
+        done_c[chan]++;
     }
-    mask[idx] = 1;
-    done_c[chan]++;
-    if (left) {
-      done_rB++;
-      while (fr_rB < n_b && mask_rB[fr_rB]) fr_rB++;
-    } else {
-      done_rA++;
+    if (!ooo) {
+      std::lock_guard<std::mutex> g(hub.mu);
+      std::vector<uint8_t> &mask = left ? mask_rB : mask_rA;
+      if (idx >= mask.size() || mask[idx]) {
+        ooo = true;
+      } else {
+        mask[idx] = 1;
+        if (left) {
+          done_rB++;
+          while (fr_rB < n_b && mask_rB[fr_rB]) fr_rB++;
+        } else {
+          done_rA++;
+        }
+        hub.bump_locked();
+      }
     }
+    if (ooo)
+      return order_fail(hub, "ring(fused2)",
+                        "out-of-order recv completion", chan);
     return 0;
   }
 
@@ -884,19 +1241,20 @@ struct FusedTwo {
   // acks on either side.
   void owed_channel(bool *left, size_t *chan) {
     size_t c = 0;
-    if (oldest_outstanding(pc_rB, done_rBc, nc, &c) !=
+    if (oldest_outstanding(hub, pc_rB, done_rBc, nc, &c) !=
         static_cast<size_t>(-1)) {
       *left = true;
       *chan = c;
       return;
     }
-    if (!use_fb && oldest_outstanding(pc_rA, done_rAc, nc, &c) !=
+    if (!use_fb && oldest_outstanding(hub, pc_rA, done_rAc, nc, &c) !=
                        static_cast<size_t>(-1)) {
       *left = false;
       *chan = c;
       return;
     }
     for (size_t i = 0; i < nc; i++) {
+      std::lock_guard<std::mutex> g(hub.chan_mu[i]);
       if (pc_sA[i] > ac_sA[i]) {
         *left = false;
         *chan = i;
@@ -912,10 +1270,116 @@ struct FusedTwo {
     *chan = 0;
   }
 
+  bool finished_locked() const {
+    return done_rB >= n_b && acked_sB >= need_sB && done_rA >= n_a &&
+           acked_sA >= n_a;
+  }
+
+  std::string stall_detail() {
+    bool left = true;
+    size_t chan = 0;
+    owed_channel(&left, &chan);
+    size_t rB, sB, rA, sA;
+    {
+      std::lock_guard<std::mutex> g(hub.mu);
+      rB = done_rB;
+      sB = acked_sB;
+      rA = done_rA;
+      sA = acked_sA;
+    }
+    return std::string("owed ") + (left ? "left" : "right") +
+           " channel " + std::to_string(chan) + "; rB " +
+           std::to_string(rB) + "/" + std::to_string(n_b) + " sB " +
+           std::to_string(sB) + "/" + std::to_string(posted_sB) + " rA " +
+           std::to_string(rA) + "/" + std::to_string(n_a) + " sA " +
+           std::to_string(sA) + "/" + std::to_string(posted_sA);
+  }
+
+  // Post the inbound streams deep (every target is a disjoint slice
+  // of the data MR) and the outbound streams as their gates open,
+  // all in global chunk order — which is per-channel FIFO order.
+  int post_more() {
+    bool progressed = false;
+    while (posted_rB < n_b) {
+      size_t c = posted_rB % nc;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+        if (pc_rB[c] - done_rBc[c] >= rb_win[c]) break;
+      }
+      if (post_recv_b(posted_rB) != 0) return -1;
+      posted_rB++;
+      progressed = true;
+    }
+    if (!use_fb) {
+      while (posted_rA < n_a) {
+        size_t c = posted_rA % nc;
+        {
+          std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+          if (pc_rA[c] - done_rAc[c] >= kMaxOutstanding) break;
+        }
+        if (post_recv_a(posted_rA) != 0) return -1;
+        posted_rA++;
+        progressed = true;
+      }
+    }
+    while (posted_sA < n_a) {
+      size_t c = posted_sA % nc;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+        if (pc_sA[c] - ac_sA[c] >= sa_win[c]) break;
+      }
+      int rc = use_fb
+                   ? tdr_post_send_foldback(r->rights[c], dmr,
+                                            a_off + posted_sA * chunk,
+                                            clen(a_len, posted_sA),
+                                            kWrSend | posted_sA)
+                   : tdr_post_send(r->rights[c], dmr,
+                                   a_off + posted_sA * chunk,
+                                   clen(a_len, posted_sA),
+                                   kWrSend | posted_sA);
+      if (rc != 0) return -1;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+        pc_sA[c]++;
+      }
+      posted_sA++;
+      progressed = true;
+    }
+    // Non-foldback: return a reduced B chunk the moment its fold
+    // completes (cache-hot). The gate is the in-order folded
+    // prefix, so the peer's rA stream sees its per-channel FIFO.
+    while (!use_fb) {
+      {
+        std::lock_guard<std::mutex> g(hub.mu);
+        if (!(posted_sB < fr_rB && posted_sB - acked_sB < kMaxOutstanding))
+          break;
+      }
+      size_t c = posted_sB % nc;
+      if (tdr_post_send(r->lefts[c], dmr, b_off + posted_sB * chunk,
+                        clen(b_len, posted_sB), kWrSend | posted_sB) != 0)
+        return -1;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+        pc_sB[c]++;
+      }
+      posted_sB++;
+      progressed = true;
+    }
+    return progressed ? 1 : 0;
+  }
+
   int run() {
     nc = r->lefts.size();
-    mask_rB.assign(n_b, 0);
-    mask_rA.assign(use_fb ? 0 : n_a, 0);
+    hub.init(nc);
+    {
+      std::lock_guard<std::mutex> g(hub.mu);
+      mask_rB.assign(n_b, 0);
+      mask_rA.assign(use_fb ? 0 : n_a, 0);
+      fr_rB = 0;
+      done_rB = acked_sB = acked_sA = done_rA = 0;
+      if (use_fb) done_rA = n_a;  // stream does not exist
+    }
+    need_sB = use_fb ? 0 : n_b;  // ditto
     done_rBc.assign(nc, 0);
     done_rAc.assign(nc, 0);
     pc_rB.assign(nc, 0);
@@ -933,68 +1397,17 @@ struct FusedTwo {
       // RNR-NAK-storm.
       sa_win[c] = reduce_recv_window(r->rights[c]);
     }
-    if (use_fb) done_rA = n_a;                // stream does not exist
-    const size_t need_sB = use_fb ? 0 : n_b;  // ditto
 
-    // Post the inbound streams deep (every target is a disjoint slice
-    // of the data MR) and the outbound streams as their gates open,
-    // all in global chunk order — which is per-channel FIFO order.
-    auto post_more = [&]() -> int {
-      bool progressed = false;
-      while (posted_rB < n_b &&
-             pc_rB[posted_rB % nc] - done_rBc[posted_rB % nc] <
-                 rb_win[posted_rB % nc]) {
-        if (post_recv_b(posted_rB) != 0) return -1;
-        posted_rB++;
-        progressed = true;
-      }
-      if (!use_fb) {
-        while (posted_rA < n_a &&
-               pc_rA[posted_rA % nc] - done_rAc[posted_rA % nc] <
-                   kMaxOutstanding) {
-          if (post_recv_a(posted_rA) != 0) return -1;
-          posted_rA++;
-          progressed = true;
-        }
-      }
-      while (posted_sA < n_a &&
-             pc_sA[posted_sA % nc] - ac_sA[posted_sA % nc] <
-                 sa_win[posted_sA % nc]) {
-        size_t c = posted_sA % nc;
-        int rc = use_fb
-                     ? tdr_post_send_foldback(r->rights[c], dmr,
-                                              a_off + posted_sA * chunk,
-                                              clen(a_len, posted_sA),
-                                              kWrSend | posted_sA)
-                     : tdr_post_send(r->rights[c], dmr,
-                                     a_off + posted_sA * chunk,
-                                     clen(a_len, posted_sA),
-                                     kWrSend | posted_sA);
-        if (rc != 0) return -1;
-        pc_sA[c]++;
-        posted_sA++;
-        progressed = true;
-      }
-      // Non-foldback: return a reduced B chunk the moment its fold
-      // completes (cache-hot). The gate is the in-order folded
-      // prefix, so the peer's rA stream sees its per-channel FIFO.
-      while (!use_fb && posted_sB < fr_rB &&
-             posted_sB - acked_sB < kMaxOutstanding) {
-        size_t c = posted_sB % nc;
-        if (tdr_post_send(r->lefts[c], dmr, b_off + posted_sB * chunk,
-                          clen(b_len, posted_sB), kWrSend | posted_sB) != 0)
-          return -1;
-        pc_sB[c]++;
-        posted_sB++;
-        progressed = true;
-      }
-      return progressed ? 1 : 0;
-    };
+    const size_t shards = tdr::progress_shards_for(nc);
+    if (shards > 0 && n_a + n_b >= 4)
+      return drive_sharded(r, *this, hub, shards, true, "ring(fused2)");
 
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(ring_timeout_ms());
-    while (done_rB < n_b || acked_sB < need_sB || done_rA < n_a ||
-           acked_sA < n_a) {
+    StallClock clock;
+    for (;;) {
+      {
+        std::lock_guard<std::mutex> g(hub.mu);
+        if (finished_locked()) break;
+      }
       int p = post_more();
       if (p < 0) return -1;
       int nl = sweep_side(r->lefts, *this, true);
@@ -1002,27 +1415,17 @@ struct FusedTwo {
       int nr = sweep_side(r->rights, *this, false);
       if (nr < 0) return -1;
       if (p > 0 || nl > 0 || nr > 0) {
-        deadline = std::chrono::steady_clock::now() +
-                   std::chrono::milliseconds(ring_timeout_ms());
+        clock.bump();
         continue;
       }
       int n = wait_owed(r, *this, 50);
       if (n < 0) return -1;
       if (n > 0) {
-        deadline = std::chrono::steady_clock::now() +
-                   std::chrono::milliseconds(ring_timeout_ms());
+        clock.bump();
         continue;
       }
-      if (std::chrono::steady_clock::now() >= deadline) {
-        tdr::set_error(
-            "ring(fused2): poll timeout (rB " + std::to_string(done_rB) +
-            "/" + std::to_string(n_b) + " sB " + std::to_string(acked_sB) +
-            "/" + std::to_string(posted_sB) + " rA " +
-            std::to_string(done_rA) + "/" + std::to_string(n_a) + " sA " +
-            std::to_string(acked_sA) + "/" + std::to_string(posted_sA) +
-            ")");
-        return -1;
-      }
+      if (clock.expired())
+        return stall_fail("ring(fused2)", stall_detail());
     }
     return 0;
   }
@@ -1058,19 +1461,23 @@ struct Wavefront {
   std::vector<WaveItem> sends, recvs;
 
   size_t nc = 1;
-  size_t posted_s = 0, acked_s = 0, posted_r = 0, done_r = 0;
+  size_t posted_s = 0, posted_r = 0;  // posting cursors (one writer)
+  size_t acked_s = 0, done_r = 0;     // aggregates, under hub.mu
   // Completion bookkeeping tolerates out-of-schedule-order recv
   // completions: channels complete independently, and a foldback
   // recv's completion is DEFERRED until the peer's write-back pull
   // acks, so a later recv can complete first. Matching is still FIFO
   // per channel at the transport — only cross-channel reporting
   // reorders — and send dependencies use the in-order completed
-  // PREFIX (frontier), never the raw count.
+  // PREFIX (frontier), never the raw count. Mask + frontier live
+  // under hub.mu: they ARE the watermark the posting side consumes.
   std::vector<uint8_t> done_mask;
   size_t frontier = 0;
-  // Per-channel in-flight accounting (window bounds) and send acks.
+  // Per-channel in-flight accounting (window bounds) and send acks,
+  // under the hub's per-channel locks.
   std::vector<size_t> pc_r, dc_r, pc_s, ac_s;
   std::vector<size_t> r_win;
+  ProgressHub hub;
 
   int post_send_item(size_t i) {
     const WaveItem &it = sends[i];
@@ -1079,7 +1486,10 @@ struct Wavefront {
                  ? tdr_post_send_foldback(qp, dmr, it.off, it.len,
                                           kWrSend | i)
                  : tdr_post_send(qp, dmr, it.off, it.len, kWrSend | i);
-    if (rc == 0) pc_s[i % nc]++;
+    if (rc == 0) {
+      std::lock_guard<std::mutex> g(hub.chan_mu[i % nc]);
+      pc_s[i % nc]++;
+    }
     return rc;
   }
   int post_recv_item(size_t i) {
@@ -1089,33 +1499,51 @@ struct Wavefront {
                  ? tdr_post_recv_reduce(qp, dmr, it.off, it.len, dtype,
                                         red_op, kWrRecv | i)
                  : tdr_post_recv(qp, dmr, it.off, it.len, kWrRecv | i);
-    if (rc == 0) pc_r[i % nc]++;
+    if (rc == 0) {
+      std::lock_guard<std::mutex> g(hub.chan_mu[i % nc]);
+      pc_r[i % nc]++;
+    }
     return rc;
   }
 
   int on_wc(bool left, size_t chan, const tdr_wc &wc) {
     (void)left;
-    if (wc.status != TDR_WC_SUCCESS) {
-      tdr::set_error("ring(wave): completion error status " +
-                     wc_status_label(wc.status));
-      return -1;
-    }
+    if (wc.status != TDR_WC_SUCCESS) return wc_fail(hub, "ring(wave)", wc);
     uint64_t kind = wc.wr_id & kWrKindMask;
     size_t idx = wc.wr_id & ~kWrKindMask;
     if (kind == kWrSend) {
-      acked_s++;
-      ac_s[idx % nc]++;
-    } else if (kind == kWrRecv) {
-      if (idx >= done_mask.size() || done_mask[idx] || idx % nc != chan) {
-        tdr::set_error("ring(wave): duplicate/foreign recv completion");
-        return -1;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[idx % nc]);
+        ac_s[idx % nc]++;
       }
-      done_mask[idx] = 1;
-      dc_r[chan]++;
-      done_r++;
-      while (frontier < done_mask.size() && done_mask[frontier])
-        frontier++;
+      std::lock_guard<std::mutex> g(hub.mu);
+      acked_s++;
+      hub.bump_locked();
+      return 0;
     }
+    if (kind != kWrRecv) return 0;
+    bool bad = false;
+    {
+      std::lock_guard<std::mutex> g(hub.mu);
+      if (idx >= done_mask.size() || done_mask[idx] || idx % nc != chan)
+        bad = true;
+    }
+    if (bad)
+      return order_fail(hub, "ring(wave)",
+                        "duplicate/foreign recv completion", chan);
+    // Per-channel counter BEFORE the watermark publication (the
+    // StepPipe/FusedTwo order): a consumer woken by the bump must see
+    // the recv window already refilled, or it re-sleeps its full
+    // slice with nothing left to notify it.
+    {
+      std::lock_guard<std::mutex> g(hub.chan_mu[chan]);
+      dc_r[chan]++;
+    }
+    std::lock_guard<std::mutex> g(hub.mu);
+    done_mask[idx] = 1;
+    done_r++;
+    while (frontier < done_mask.size() && done_mask[frontier]) frontier++;
+    hub.bump_locked();
     return 0;
   }
 
@@ -1124,12 +1552,14 @@ struct Wavefront {
   // send ack.
   void owed_channel(bool *left, size_t *chan) {
     size_t c = 0;
-    if (oldest_outstanding(pc_r, dc_r, nc, &c) != static_cast<size_t>(-1)) {
+    if (oldest_outstanding(hub, pc_r, dc_r, nc, &c) !=
+        static_cast<size_t>(-1)) {
       *left = true;
       *chan = c;
       return;
     }
     for (size_t i = 0; i < nc; i++) {
+      std::lock_guard<std::mutex> g(hub.chan_mu[i]);
       if (pc_s[i] > ac_s[i]) {
         *left = false;
         *chan = i;
@@ -1140,10 +1570,76 @@ struct Wavefront {
     *chan = 0;
   }
 
+  bool finished_locked() const {
+    return acked_s >= sends.size() && done_r >= recvs.size();
+  }
+
+  std::string stall_detail() {
+    bool left = true;
+    size_t chan = 0;
+    owed_channel(&left, &chan);
+    size_t as, dr, fr, dep = 0;
+    {
+      std::lock_guard<std::mutex> g(hub.mu);
+      as = acked_s;
+      dr = done_r;
+      fr = frontier;
+    }
+    if (posted_s < sends.size()) dep = sends[posted_s].dep;
+    return std::string("owed ") + (left ? "recv" : "send-ack") +
+           " on channel " + std::to_string(chan) + "; s " +
+           std::to_string(as) + "/" + std::to_string(sends.size()) +
+           " r " + std::to_string(dr) + "/" +
+           std::to_string(recvs.size()) + " frontier " +
+           std::to_string(fr) + " next-dep " + std::to_string(dep);
+  }
+
+  int post_more() {
+    bool progressed = false;
+    // Keep the recv windows deep (disjoint targets; per-channel
+    // FIFO-matched because global order IS per-channel order).
+    while (posted_r < recvs.size()) {
+      size_t c = posted_r % nc;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+        if (pc_r[c] - dc_r[c] >= r_win[c]) break;
+      }
+      if (post_recv_item(posted_r) != 0) return -1;
+      posted_r++;
+      progressed = true;
+    }
+    // Post sends strictly in schedule order as their dependency
+    // (the same-segment recv of the previous step) completes.
+    // In-flight sends bounded by the peer's per-channel recv window
+    // (≈ r_win; symmetric schedule) to avoid RNR storms on real
+    // HCAs.
+    while (posted_s < sends.size()) {
+      size_t c = posted_s % nc;
+      {
+        std::lock_guard<std::mutex> g(hub.chan_mu[c]);
+        if (pc_s[c] - ac_s[c] >= r_win[c]) break;
+      }
+      {
+        std::lock_guard<std::mutex> g(hub.mu);
+        if (frontier < sends[posted_s].dep) break;
+      }
+      if (post_send_item(posted_s) != 0) return -1;
+      posted_s++;
+      progressed = true;
+    }
+    return progressed ? 1 : 0;
+  }
+
   int run() {
     nc = r->lefts.size();
+    hub.init(nc);
     const size_t N = sends.size(), M = recvs.size();
-    done_mask.assign(M, 0);
+    {
+      std::lock_guard<std::mutex> g(hub.mu);
+      done_mask.assign(M, 0);
+      frontier = 0;
+      acked_s = done_r = 0;
+    }
     pc_r.assign(nc, 0);
     dc_r.assign(nc, 0);
     pc_s.assign(nc, 0);
@@ -1155,36 +1651,16 @@ struct Wavefront {
     for (size_t c = 0; c < nc; c++)
       r_win[c] = reduce_recv_window(r->lefts[c]);
 
-    auto post_more = [&]() -> int {
-      bool progressed = false;
-      // Keep the recv windows deep (disjoint targets; per-channel
-      // FIFO-matched because global order IS per-channel order).
-      while (posted_r < M &&
-             pc_r[posted_r % nc] - dc_r[posted_r % nc] <
-                 r_win[posted_r % nc]) {
-        if (post_recv_item(posted_r) != 0) return -1;
-        posted_r++;
-        progressed = true;
-      }
-      // Post sends strictly in schedule order as their dependency
-      // (the same-segment recv of the previous step) completes.
-      // In-flight sends bounded by the peer's per-channel recv window
-      // (≈ r_win; symmetric schedule) to avoid RNR storms on real
-      // HCAs.
-      while (posted_s < N &&
-             pc_s[posted_s % nc] - ac_s[posted_s % nc] <
-                 r_win[posted_s % nc] &&
-             frontier >= sends[posted_s].dep) {
-        if (post_send_item(posted_s) != 0) return -1;
-        posted_s++;
-        progressed = true;
-      }
-      return progressed ? 1 : 0;
-    };
+    const size_t shards = tdr::progress_shards_for(nc);
+    if (shards > 0 && N + M >= 4)
+      return drive_sharded(r, *this, hub, shards, true, "ring(wave)");
 
-    auto deadline = std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(ring_timeout_ms());
-    while (acked_s < N || done_r < M) {
+    StallClock clock;
+    for (;;) {
+      {
+        std::lock_guard<std::mutex> g(hub.mu);
+        if (finished_locked()) break;
+      }
       int p = post_more();
       if (p < 0) return -1;
       int nl = sweep_side(r->lefts, *this, true);
@@ -1192,24 +1668,16 @@ struct Wavefront {
       int nr = sweep_side(r->rights, *this, false);
       if (nr < 0) return -1;
       if (p > 0 || nl > 0 || nr > 0) {
-        deadline = std::chrono::steady_clock::now() +
-                   std::chrono::milliseconds(ring_timeout_ms());
+        clock.bump();
         continue;
       }
       int n = wait_owed(r, *this, 50);
       if (n < 0) return -1;
       if (n > 0) {
-        deadline = std::chrono::steady_clock::now() +
-                   std::chrono::milliseconds(ring_timeout_ms());
+        clock.bump();
         continue;
       }
-      if (std::chrono::steady_clock::now() >= deadline) {
-        tdr::set_error("ring(wave): poll timeout (s " +
-                       std::to_string(acked_s) + "/" + std::to_string(N) +
-                       " r " + std::to_string(done_r) + "/" +
-                       std::to_string(M) + ")");
-        return -1;
-      }
+      if (clock.expired()) return stall_fail("ring(wave)", stall_detail());
     }
     return 0;
   }

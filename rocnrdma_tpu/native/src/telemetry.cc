@@ -52,7 +52,14 @@ size_t g_count = 0;  // live events in the ring
 std::atomic<uint64_t> g_recorded{0};
 std::atomic<uint64_t> g_dropped{0};
 
-std::atomic<uint64_t> g_hists[TDR_HIST_COUNT][64];
+// Histograms are log-linear ("log2 × 8"): 8 linear sub-buckets per
+// power-of-two octave, values 0..15 exact. BENCH_r06 showed why pure
+// log2 buckets are not enough: every latency percentile sat at an
+// octave upper edge (8191/32767/65535 µs) — the estimate was the
+// bucket, not the value. Sub-bucketing bounds the relative error at
+// 12.5% while keeping the same O(1) atomic-increment recording; the
+// legacy 64-octave read view is derived by folding sub-buckets.
+std::atomic<uint64_t> g_hists[TDR_HIST_COUNT][TDR_HIST_FINE_BUCKETS];
 
 std::atomic<uint32_t> g_next_engine{0};
 std::atomic<uint32_t> g_next_qp{0};
@@ -70,19 +77,53 @@ size_t ring_capacity_env() {
 }
 
 int bucket_of(uint64_t v) {
-  // Bucket 0 holds zeros; bucket b (1..63) holds [2^(b-1), 2^b) —
-  // i.e. b = bit_length(v), mirroring Python's int.bit_length().
-  // Values with bit 63 set would index bucket 64: clamp into the last
-  // bucket instead of storing past the row.
+  // Octave index: bucket 0 holds zeros; bucket b (1..63) holds
+  // [2^(b-1), 2^b) — i.e. b = bit_length(v), mirroring Python's
+  // int.bit_length(). Values with bit 63 set would index bucket 64:
+  // clamp into the last bucket instead of storing past the row.
   int b = v ? 64 - __builtin_clzll(v) : 0;
   return b > 63 ? 63 : b;
+}
+
+// Fine (log-linear) bucket: values < 16 index themselves; above that,
+// the 3 bits below the MSB select one of 8 linear sub-buckets inside
+// the value's octave. Contiguous: v=15 -> 15, v=16 -> 16.
+int fine_bucket_of(uint64_t v) {
+  if (v < 16) return static_cast<int>(v);
+  int b = 64 - __builtin_clzll(v);  // bit_length, >= 5
+  int sub = static_cast<int>((v >> (b - 4)) & 7);
+  int idx = (b - 4) * 8 + 8 + sub;
+  return idx >= TDR_HIST_FINE_BUCKETS ? TDR_HIST_FINE_BUCKETS - 1 : idx;
+}
+
+// Inclusive upper edge of a fine bucket (the conservative percentile
+// estimate the Python side mirrors byte-for-byte).
+uint64_t fine_upper_of(int idx) {
+  if (idx < 16) return idx < 0 ? 0 : static_cast<uint64_t>(idx);
+  int b = (idx - 8) / 8 + 4;       // octave (bit_length of members)
+  int sub = (idx - 8) % 8;
+  // Members are [ (8+sub) << (b-4), (8+sub+1) << (b-4) ); the << can
+  // reach 2^64 at the top octave — unsigned wrap makes the -1 yield
+  // UINT64_MAX, which is exactly the intended edge.
+  return (static_cast<uint64_t>(8 + sub + 1) << (b - 4)) - 1;
+}
+
+// Octave a fine bucket belongs to — the legacy 64-bucket fold
+// (buckets below 16 hold exact values, so their octave is bucket_of
+// of the value itself). Clamped at 63 like bucket_of: the top fine
+// buckets (bit-length-64 values) must fold into the last octave row,
+// not index out[64] past the caller's array.
+int fine_to_octave(int idx) {
+  if (idx < 16) return bucket_of(static_cast<uint64_t>(idx));
+  int oct = (idx - 8) / 8 + 4;
+  return oct > 63 ? 63 : oct;
 }
 
 const char *kEventNames[] = {
     "none",       "post_send", "post_recv", "post_write", "post_read",
     "wire_tx",    "wire_rx",   "land",      "verify_ok",  "verify_fail",
     "nak",        "retx",      "fold",      "wc",         "copy_enq",
-    "copy_run",   "ring_begin", "ring_end", "fold_off",
+    "copy_run",   "ring_begin", "ring_end", "fold_off",   "shard",
 };
 constexpr int kEventCount =
     static_cast<int>(sizeof(kEventNames) / sizeof(kEventNames[0]));
@@ -129,7 +170,8 @@ void tel_emit(uint16_t type, uint16_t engine, uint32_t qp, uint64_t id,
 
 void tel_hist_add(int which, uint64_t value) {
   if (which < 0 || which >= TDR_HIST_COUNT) return;
-  g_hists[which][bucket_of(value)].fetch_add(1, std::memory_order_relaxed);
+  g_hists[which][fine_bucket_of(value)].fetch_add(
+      1, std::memory_order_relaxed);
 }
 
 uint16_t tel_next_engine_id() {
@@ -139,6 +181,14 @@ uint16_t tel_next_engine_id() {
 
 uint32_t tel_next_qp_id() {
   return g_next_qp.fetch_add(1, std::memory_order_relaxed) + 1;
+}
+
+uint32_t tel_thread_track() {
+  // One lane per helper thread, drawn lazily from the QP track space
+  // the first time the thread emits — fold workers and progress
+  // shards get stable exported lanes without pre-registration.
+  thread_local uint32_t track = tel_next_qp_id();
+  return track;
 }
 
 }  // namespace tdr
@@ -198,13 +248,32 @@ const char *tdr_tel_hist_name(int which) {
 }
 
 void tdr_tel_hist_read(int which, uint64_t out[64]) {
+  // Legacy 64-octave view, derived by folding the fine sub-buckets —
+  // existing consumers (tdr_top sparklines, /metrics quantiles) keep
+  // their shape; percentile consumers should read the fine view.
   if (!out) return;
-  if (which < 0 || which >= TDR_HIST_COUNT) {
-    memset(out, 0, 64 * sizeof(uint64_t));
-    return;
+  memset(out, 0, 64 * sizeof(uint64_t));
+  if (which < 0 || which >= TDR_HIST_COUNT) return;
+  for (int b = 0; b < TDR_HIST_FINE_BUCKETS; b++) {
+    uint64_t c = tdr::g_hists[which][b].load(std::memory_order_relaxed);
+    if (c) out[tdr::fine_to_octave(b)] += c;
   }
-  for (int b = 0; b < 64; b++)
+}
+
+int tdr_tel_hist_fine_buckets(void) { return TDR_HIST_FINE_BUCKETS; }
+
+uint64_t tdr_tel_hist_fine_upper(int idx) { return tdr::fine_upper_of(idx); }
+
+int tdr_tel_hist_read_fine(int which, uint64_t *out, int max) {
+  if (!out || max <= 0) return 0;
+  int n = max < TDR_HIST_FINE_BUCKETS ? max : TDR_HIST_FINE_BUCKETS;
+  if (which < 0 || which >= TDR_HIST_COUNT) {
+    memset(out, 0, static_cast<size_t>(n) * sizeof(uint64_t));
+    return n;
+  }
+  for (int b = 0; b < n; b++)
     out[b] = tdr::g_hists[which][b].load(std::memory_order_relaxed);
+  return n;
 }
 
 int tdr_tel_engine_id(const tdr_engine *e) {
@@ -230,6 +299,8 @@ const char *kCounterNames[] = {
     "integrity.retransmitted", "fault.seen",    "fault.hits",
     "copy.nt_bytes",      "copy.plain_bytes",   "telemetry.recorded",
     "telemetry.dropped",  "fold.jobs",          "fold.busy_us",
+    "fold.pending",       "progress.shards",    "progress.wakeups",
+    "progress.wc",
 };
 constexpr int kRegistryCount =
     static_cast<int>(sizeof(kCounterNames) / sizeof(kCounterNames[0]));
@@ -247,6 +318,8 @@ void read_all(uint64_t out[kRegistryCount]) {
   out[9] = tdr::g_dropped.load(std::memory_order_relaxed);
   out[10] = tdr::fold_jobs();
   out[11] = tdr::fold_busy_us();
+  out[12] = tdr::fold_pending();
+  tdr::progress_counters(&out[13], &out[14], &out[15]);
 }
 
 }  // namespace

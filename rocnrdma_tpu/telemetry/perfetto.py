@@ -60,6 +60,7 @@ def export_trace(path: Optional[str] = None,
     trace_events: List[Dict[str, Any]] = []
     seen_pids: Dict[int, None] = {}
     seen_tids: Dict[tuple, None] = {}
+    lane_names: Dict[tuple, set] = {}  # event names seen per lane
 
     for ev in sorted(events, key=lambda e: (e.ts_ns, e.engine, e.qp,
                                             e.name, e.id)):
@@ -68,6 +69,8 @@ def export_trace(path: Optional[str] = None,
         tid = ev.qp if ev.source == "native" else 0
         seen_pids.setdefault(pid)
         seen_tids.setdefault((pid, tid))
+        if ev.source == "native":
+            lane_names.setdefault((pid, tid), set()).add(ev.name)
         if ev.source == "python" and "dur_s" in ev.fields:
             dur_us = float(ev.fields["dur_s"]) * 1e6
             args = {k: v for k, v in ev.fields.items() if k != "dur_s"}
@@ -91,8 +94,22 @@ def export_trace(path: Optional[str] = None,
         label = labels.get(pid, "python" if pid == 0 else f"engine{pid}")
         meta.append(_meta(pid, None, label))
     for pid, tid in sorted(seen_tids):
-        name = ("engine" if tid == 0 else f"qp{tid}") \
-            if pid != 0 else "tracer"
+        # Helper-thread lanes (progress shards, fold workers) share
+        # the QP track-id space but carry only their own event kinds:
+        # name them by what runs on them, so the per-shard and fold
+        # lanes read as parallel workers next to the QP lanes instead
+        # of masquerading as connections.
+        kinds = lane_names.get((pid, tid), set())
+        if pid == 0 and tid == 0:
+            name = "tracer"
+        elif tid == 0:
+            name = "engine"
+        elif "shard" in kinds:
+            name = f"shard{tid}"
+        elif kinds and kinds <= {"fold", "fold_off"}:
+            name = f"fold{tid}"
+        else:
+            name = f"qp{tid}"
         meta.append(_meta(pid, tid, name))
 
     doc = {

@@ -135,26 +135,46 @@ def histograms() -> Dict[str, List[int]]:
 # ------------------------------------------------------------ buckets
 
 def bucket_upper(b: int) -> int:
-    """Upper edge of log2 bucket ``b``: bucket 0 holds zeros; bucket b
-    (>=1) holds values v with v.bit_length() == b, i.e.
+    """Upper edge of log2 OCTAVE bucket ``b``: bucket 0 holds zeros;
+    bucket b (>=1) holds values v with v.bit_length() == b, i.e.
     [2^(b-1), 2^b)."""
     return 0 if b <= 0 else (1 << b) - 1
 
 
+def fine_bucket_upper(b: int) -> int:
+    """Upper edge of FINE (log2 × 8) bucket ``b``: values 0..15 index
+    themselves; above that, 8 linear sub-buckets per octave — bucket
+    members are [(8+sub) << (oct-4), (8+sub+1) << (oct-4)). Mirrors
+    the native fine_upper_of byte-for-byte (pinned against
+    tdr_tel_hist_fine_upper in tests), so percentile estimates agree
+    across languages."""
+    if b < 0:
+        return 0
+    if b < 16:
+        return b
+    oct_ = (b - 8) // 8 + 4
+    sub = (b - 8) % 8
+    return ((8 + sub + 1) << (oct_ - 4)) - 1
+
+
 def hist_percentile(buckets: Sequence[int], q: float) -> int:
-    """Percentile estimate from a log2 histogram — the UPPER edge of
+    """Percentile estimate from a histogram row — the UPPER edge of
     the bucket containing the q-quantile (conservative for latencies:
-    the true value is <= the estimate). q in [0, 100]."""
+    the true value is <= the estimate). q in [0, 100]. Rows longer
+    than 64 are fine (log2 × 8) rows whose sub-octave edges bound the
+    quantization error at 12.5% — the BENCH_r06 "saturated
+    percentiles" fix: estimates are real numbers, not octave edges."""
     total = sum(buckets)
     if total == 0:
         return 0
+    upper = bucket_upper if len(buckets) <= 64 else fine_bucket_upper
     target = total * q / 100.0
     acc = 0
     for b, count in enumerate(buckets):
         acc += count
         if acc >= target and count:
-            return bucket_upper(b)
-    return bucket_upper(len(buckets) - 1)
+            return upper(b)
+    return upper(len(buckets) - 1)
 
 
 def hist_percentiles(buckets: Sequence[int],
@@ -164,10 +184,14 @@ def hist_percentiles(buckets: Sequence[int],
 
 def snapshot() -> Dict[str, Any]:
     """Counters + histograms + latency percentiles in one JSONable
-    dict — what ``tdr_top`` renders and the bench record embeds."""
+    dict — what ``tdr_top`` renders and the bench record embeds.
+    Histograms ship in the compact 64-octave view (sparklines);
+    percentiles are computed from the FINE rows, so they carry
+    sub-octave resolution."""
     from rocnrdma_tpu.transport import engine as eng
 
     hists = histograms()
+    fine = eng.telemetry_histograms_fine()
     return {
         "enabled": enabled(),
         "recorded": eng.telemetry_recorded(),
@@ -176,7 +200,7 @@ def snapshot() -> Dict[str, Any]:
         "histograms": hists,
         "percentiles": {
             name: hist_percentiles(buckets)
-            for name, buckets in hists.items()
+            for name, buckets in fine.items()
         },
     }
 

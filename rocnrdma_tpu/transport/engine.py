@@ -265,6 +265,15 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_tel_hist_read.restype = None
     lib.tdr_tel_hist_read.argtypes = [ctypes.c_int,
                                       ctypes.POINTER(ctypes.c_uint64)]
+    lib.tdr_tel_hist_fine_buckets.restype = ctypes.c_int
+    lib.tdr_tel_hist_fine_upper.restype = ctypes.c_uint64
+    lib.tdr_tel_hist_fine_upper.argtypes = [ctypes.c_int]
+    lib.tdr_tel_hist_read_fine.restype = ctypes.c_int
+    lib.tdr_tel_hist_read_fine.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.tdr_progress_shards.restype = ctypes.c_int
+    lib.tdr_progress_shards.argtypes = [ctypes.c_int]
     lib.tdr_tel_engine_id.restype = ctypes.c_int
     lib.tdr_tel_engine_id.argtypes = [P]
     lib.tdr_tel_qp_id.restype = ctypes.c_int
@@ -372,6 +381,17 @@ def ring_channels_default() -> int:
     return max(1, min(v, 16))
 
 
+def progress_shards(channels: Optional[int] = None) -> int:
+    """Resolved progress-shard count for a ring with ``channels``
+    channels, as the NATIVE layer parses TDR_PROGRESS_SHARDS (the
+    schedule digest never carries this — progress sharding is
+    per-process execution strategy). 0 = the legacy single-poll loop
+    (forced by TDR_PROGRESS_SHARDS=0, and the default on 1-core
+    hosts); otherwise one dedicated poll thread per channel group."""
+    ch = ring_channels_default() if channels is None else int(channels)
+    return int(_load().tdr_progress_shards(ch))
+
+
 def copy_counters() -> Tuple[int, int]:
     """(nt_bytes, plain_bytes) moved via the streaming vs cached copy
     tiers since process start — which path carried the traffic."""
@@ -435,13 +455,43 @@ def telemetry_drain(max_events: int = 65536) -> List[TelEventC]:
 
 
 def telemetry_histograms() -> dict:
-    """All native log2-bucket histograms: name -> 64 bucket counts
-    (bucket b counts values in [2^(b-1), 2^b); bucket 0 zeros)."""
+    """All native histograms in the legacy 64-octave view: name -> 64
+    bucket counts (bucket b counts values in [2^(b-1), 2^b); bucket 0
+    zeros). Derived by folding the fine rows — percentile consumers
+    should use ``telemetry_histograms_fine`` for sub-octave
+    resolution."""
     lib = _load()
     out = {}
     for i in range(int(lib.tdr_tel_hist_count())):
         buckets = (ctypes.c_uint64 * 64)()
         lib.tdr_tel_hist_read(i, buckets)
+        out[lib.tdr_tel_hist_name(i).decode()] = [int(v) for v in buckets]
+    return out
+
+
+def telemetry_hist_fine_buckets() -> int:
+    """Length of a fine (log2 × 8) histogram row."""
+    return int(_load().tdr_tel_hist_fine_buckets())
+
+
+def telemetry_hist_fine_upper(idx: int) -> int:
+    """Inclusive upper edge of fine bucket ``idx`` — read from the
+    native layer so Python percentile estimates can never drift from
+    the recorder's bucket assignment."""
+    return int(_load().tdr_tel_hist_fine_upper(idx))
+
+
+def telemetry_histograms_fine() -> dict:
+    """All native histograms at fine (log2 × 8) resolution: name ->
+    TDR_HIST_FINE_BUCKETS counts, 8 linear sub-buckets per octave
+    (values 0..15 exact) — relative quantization error <= 12.5%, so
+    percentile estimates are real numbers, not octave edges."""
+    lib = _load()
+    n = int(lib.tdr_tel_hist_fine_buckets())
+    out = {}
+    for i in range(int(lib.tdr_tel_hist_count())):
+        buckets = (ctypes.c_uint64 * n)()
+        lib.tdr_tel_hist_read_fine(i, buckets, n)
         out[lib.tdr_tel_hist_name(i).decode()] = [int(v) for v in buckets]
     return out
 

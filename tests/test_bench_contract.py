@@ -68,11 +68,12 @@ def test_details_file_exists_and_carries_the_bulk(bench_run):
 
 
 def test_bench_record_carries_channel_sweep_and_fold_occupancy(bench_run):
-    """BENCH_r06 contract: the machine-readable record carries the
+    """BENCH_r07 contract: the machine-readable record carries the
     multi-channel sweep (per-channel-count bus bandwidth for
-    TDR_RING_CHANNELS in {1,2,4,8}) and the fold-offload occupancy of
-    the windowed-scratch run — quick mode writes the identical schema
-    beside the details file."""
+    TDR_RING_CHANNELS in {1,2,4,8}), the auto-capped channel pick, the
+    sharded-progress accounting, the fold-offload occupancy of the
+    striped windowed run, and NON-SATURATED latency percentiles —
+    quick mode writes the identical schema beside the details file."""
     out = json.loads(bench_run.stdout.splitlines()[-1])
     details_path = out["details_file"]
     if not os.path.isabs(details_path):
@@ -86,13 +87,36 @@ def test_bench_record_carries_channel_sweep_and_fold_occupancy(bench_run):
     assert all(isinstance(v, (int, float)) and v > 0
                for v in by_ch.values()), by_ch
     assert record["allreduce_world4_channels"] in (1, 2, 4, 8)
+    # Auto-cap: the sweep's best measured count is the auto pick, and
+    # the sweep-free heuristic's answer rides along for drift checks.
+    assert record["allreduce_world4_channels_auto"] in (1, 2, 4, 8)
+    assert record["allreduce_world4_channels_heuristic_cap"] >= 1
+    assert record["allreduce_world4_channels_monotone"] in (True, False)
+    # Sharded progress engine: the resolved shard count is recorded
+    # (0 = legacy loop on core-starved hosts — still a valid record).
+    assert isinstance(record["progress_shards"], int)
     fold = record["fold_offload"]
     assert "threads" in fold and "occupancy_by_channels" in fold
     windowed = fold["windowed"]
     assert windowed["bus_GBps"] > 0
     assert windowed["fold_offload_occupancy"] >= 0
-    # vs_bound rides the record too (the acceptance headline).
+    assert windowed["fold_jobs"] > 0, \
+        "the windowed occupancy run never engaged the fold pool"
+    assert "progress_wc" in windowed
+    # vs_bound rides the record too (the acceptance headline), plus
+    # the host-attainable ratio (1-core hosts: folds + copies share
+    # the core, so vs_bound alone under-reports efficiency).
     assert "allreduce_world4_vs_bound" in record
+    assert "allreduce_world4_vs_host_bound" in record
+    # Latency percentiles are fine-resolution (log2 × 8) and not
+    # saturated — the r06 record's 8191/32767/65535 signature is a
+    # regression this contract rejects.
+    assert record["lat"]["hist_resolution"] == "log2x8"
+    assert record["lat"]["saturated"] is False
+    for key in ("chunk_us", "ring_us"):
+        pcts = record["lat"][key]
+        assert pcts and all(isinstance(v, int) and v >= 0
+                            for v in pcts.values()), (key, pcts)
     assert "staged_pipelined" in record["bw_GBps"]
     assert "staged_serial" in record["bw_GBps"]
 

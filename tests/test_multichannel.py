@@ -1,15 +1,23 @@
-"""Multi-channel ring tests (TDR_RING_CHANNELS).
+"""Multi-channel ring tests (TDR_RING_CHANNELS, TDR_PROGRESS_SHARDS).
 
 The striped schedules route chunk i over channel i % channels, so the
 wire transfer, seal verification, and fold of consecutive chunks run
-on independent QPs/progress engines. These tests pin the properties
-that make that safe: bitwise parity with the single-QP schedule at
-every channel count, channel-local seal NAK/retransmit under
-deterministic corruption, survival of a mid-soak connection drop via
-rebuild, and the schedule digest growing the channel count — with
-channels=1 reproducing the legacy single-QP digest byte-for-byte.
+on independent QPs/progress engines; the SHARDED progress engine
+(TDR_PROGRESS_SHARDS) moves completion polling onto dedicated shard
+threads so no channel's progress waits behind a blocking poll owed to
+another. These tests pin the properties that make that safe: bitwise
+parity with the single-QP schedule at every channel count AND every
+shard count (0 = the legacy single-poll loop), channel-local seal
+NAK/retransmit under deterministic corruption — sharded included —
+survival of a mid-soak connection drop via rebuild with no leaked
+shard threads, the flight-recorder proof that offloaded folds overlap
+wire activity, and the schedule digest growing the channel count —
+with channels=1 reproducing the legacy single-QP digest byte-for-byte
+(progress sharding never touches the digest: it is per-process
+execution strategy).
 """
 
+import os
 import threading
 
 import numpy as np
@@ -18,10 +26,16 @@ import pytest
 from rocnrdma_tpu.collectives.world import RingWorld, local_worlds
 from rocnrdma_tpu.transport.engine import (TransportError,
                                            fault_plan_reset,
+                                           native_counters,
                                            seal_counters,
                                            seal_counters_reset)
 
 from test_transport import free_port
+
+
+def _task_count() -> int:
+    """Native thread count of this process (shard-leak detector)."""
+    return len(os.listdir("/proc/self/task"))
 
 
 def _allreduce_all(worlds, bufs):
@@ -62,6 +76,33 @@ def test_channels_default_and_property(monkeypatch):
     finally:
         for w in worlds:
             w.close()
+
+
+def test_channels_auto_applies_host_cap(monkeypatch):
+    """channels="auto" resolves via the cores-vs-local-ranks heuristic
+    instead of blindly taking TDR_RING_CHANNELS, and the world still
+    allreduces correctly at the resolved count. A bogus string raises
+    up front."""
+    from rocnrdma_tpu.collectives.world import auto_channel_cap
+
+    monkeypatch.setenv("TDR_RING_CHANNELS", "8")
+    expected = auto_channel_cap(["127.0.0.1"] * 2, 0)
+    assert 1 <= expected <= 8
+    worlds = local_worlds(2, free_port(), channels="auto")
+    try:
+        for w in worlds:
+            assert w.channels == expected
+            assert w.ring.channels == expected
+        bufs = _inputs(2, 4096)
+        assert all(e is None for e in _allreduce_all(worlds, bufs))
+        expect = sum(_inputs(2, 4096), np.zeros(4096, dtype=np.float32))
+        for b in bufs:
+            assert b.tobytes() == expect.tobytes()
+    finally:
+        for w in worlds:
+            w.close()
+    with pytest.raises(ValueError):
+        RingWorld(worlds[0].engine, 0, 2, free_port(), channels="fastest")
 
 
 @pytest.mark.parametrize("world", [2, 4])
@@ -275,3 +316,277 @@ def test_windowed_fold_offload_parity(monkeypatch):
     baseline = results[("inline", 1)]
     for key, val in results.items():
         assert val == baseline, f"{key} diverged from inline/1-channel"
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_progress_shards_bitwise_parity(world, monkeypatch):
+    """TDR_PROGRESS_SHARDS in {0, 1, 2, channels} produces
+    byte-identical allreduce results on the same inputs at channels=4
+    — 0 being the legacy single-poll loop and 1 the single-shard
+    engine, whose results must be indistinguishable from it (the
+    acceptance pin: shards are execution strategy, never schedule).
+    The progress.wc counter proves which engine actually ran."""
+    count = (1 << 20) // 4
+    monkeypatch.setenv("TDR_RING_CHANNELS", "4")
+    monkeypatch.setenv("TDR_RING_CHUNK", str(64 << 10))  # many chunks
+    results = {}
+    for shards in (0, 1, 2, 4):
+        monkeypatch.setenv("TDR_PROGRESS_SHARDS", str(shards))
+        before = native_counters()["progress.wc"]
+        worlds = local_worlds(world, free_port())
+        bufs = _inputs(world, count)
+        try:
+            errs = _allreduce_all(worlds, bufs)
+            assert all(e is None for e in errs), errs
+            results[shards] = [b.tobytes() for b in bufs]
+        finally:
+            for w in worlds:
+                w.close()
+        consumed = native_counters()["progress.wc"] - before
+        if shards == 0:
+            assert consumed == 0, \
+                "legacy mode must not consume completions on shards"
+        else:
+            assert consumed > 0, \
+                f"shards={shards} never consumed a completion"
+    for shards in (1, 2, 4):
+        assert results[shards] == results[0], \
+            f"shards={shards} diverged from the legacy single-poll loop"
+
+
+def test_corrupt_rider_channel_local_under_shards(monkeypatch):
+    """The corrupt-rider contract holds under SHARDED progress: a
+    deterministic send-site corruption on chunk 0 with full CMA
+    sealing NAKs/retransmits on chunk 0's channel ONLY (per-QP seal
+    state survives the move of polling onto shard threads) and the
+    result heals bitwise."""
+    from rocnrdma_tpu import telemetry
+
+    monkeypatch.setenv("TDR_RING_CHANNELS", "4")
+    monkeypatch.setenv("TDR_PROGRESS_SHARDS", "2")
+    monkeypatch.setenv("TDR_RING_CHUNK", str(64 << 10))
+    monkeypatch.setenv("TDR_SEAL_CMA", "1")  # payload CRC on CMA
+    count = (1 << 20) // 4
+    worlds = local_worlds(2, free_port())
+    clean = _inputs(2, count)
+    try:
+        assert all(e is None for e in _allreduce_all(worlds, clean))
+    finally:
+        for w in worlds:
+            w.close()
+
+    monkeypatch.setenv("TDR_FAULT_PLAN", "send:chunk=0:nth=1:corrupt=3")
+    fault_plan_reset()
+    seal_counters_reset()
+    telemetry.enable()
+    try:
+        before_wc = native_counters()["progress.wc"]
+        worlds = local_worlds(2, free_port())
+        faulty = _inputs(2, count)
+        try:
+            assert all(e is None for e in _allreduce_all(worlds, faulty))
+        finally:
+            for w in worlds:
+                w.close()
+        for c, f in zip(clean, faulty):
+            assert c.tobytes() == f.tobytes()
+        assert native_counters()["progress.wc"] > before_wc, \
+            "sharded progress engine never engaged"
+        c = seal_counters()
+        assert c["failed"] >= 1 and c["retransmitted"] >= 1, c
+        events = telemetry.drain()
+        naks = {e.qp for e in events if e.name == "nak"}
+        retx = {e.qp for e in events if e.name == "retx"}
+        assert retx, "no retransmission recorded"
+        assert len(naks) == 1 and len(retx) == 1, (naks, retx)
+    finally:
+        telemetry.disable()
+        monkeypatch.delenv("TDR_FAULT_PLAN", raising=False)
+        fault_plan_reset()
+        seal_counters_reset()
+
+
+def test_shard_threads_join_across_drop_and_rebuild(monkeypatch):
+    """A conn-drop mid-soak under SHARDED progress surfaces retryable
+    and rebuild() restarts cleanly — and the shard threads are
+    per-collective (spawn/join inside the call), so the process's
+    native thread count is flat across the whole soak+rebuild cycle:
+    no leaked shard thread survives an errored collective or a
+    rebuild."""
+    monkeypatch.setenv("TDR_RING_CHANNELS", "4")
+    monkeypatch.setenv("TDR_PROGRESS_SHARDS", "2")
+    monkeypatch.setenv("TDR_RING_CHUNK", str(32 << 10))
+    monkeypatch.setenv("TDR_RING_TIMEOUT_MS", "30000")
+    count = (256 << 10) // 4
+    worlds = local_worlds(2, free_port())
+    try:
+        good = _inputs(2, count)
+        assert all(e is None for e in _allreduce_all(worlds, good))
+        # Steady-state thread census AFTER the first collective: the
+        # engine progress threads and any lazily-built pools exist by
+        # now; only leaked shard threads could grow it from here.
+        tasks0 = _task_count()
+
+        monkeypatch.setenv("TDR_FAULT_PLAN", "conn:drop_after=3")
+        fault_plan_reset()
+        errs = []
+        for _ in range(8):  # soak until the drop clause fires
+            bufs = _inputs(2, count)
+            errs = _allreduce_all(worlds, bufs)
+            if any(e is not None for e in errs):
+                break
+        assert any(e is not None for e in errs), \
+            "drop rider never surfaced"
+        # At least one rank classifies the drop as retryable — the
+        # elastic ladder's entry point. The OTHER rank may race the
+        # first rank's teardown: these buffers are per-call
+        # registered, so the failing rank's exit deregisters its data
+        # MR while peer frames are still in flight on the surviving
+        # channels, and those land against an invalidated MR
+        # (LOC_ACCESS_ERR — not retryable by taxonomy). rebuild()
+        # below recovers either way; ring-registered steady-state
+        # buffers never hit this seam.
+        assert any(e is not None and e.retryable for e in errs), errs
+
+        monkeypatch.delenv("TDR_FAULT_PLAN")
+        fault_plan_reset()
+        ts = [threading.Thread(
+            target=lambda r=r: worlds[r].rebuild(
+                max_attempts=8, backoff_s=0.05, timeout_ms=10000))
+            for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert [w.generation for w in worlds] == [1, 1]
+        assert all(len(w.left_qps) == 4 for w in worlds)
+        for _ in range(3):
+            bufs = _inputs(2, count)
+            expect = sum(_inputs(2, count),
+                         np.zeros(count, dtype=np.float32))
+            assert all(e is None for e in _allreduce_all(worlds, bufs))
+            for b in bufs:
+                assert b.tobytes() == expect.tobytes()
+        # Rebuild replaced the per-QP progress threads 1:1 and every
+        # shard thread joined at its collective's exit — the census
+        # must settle back to the baseline (transient entries for
+        # just-exited python/helper threads are given time to reap; a
+        # LEAKED shard thread never exits, so it would hold the count
+        # up past the deadline).
+        import time as _time
+
+        deadline = _time.time() + 5
+        while _task_count() > tasks0 and _time.time() < deadline:
+            _time.sleep(0.05)
+        assert _task_count() <= tasks0, \
+            (f"native threads grew {tasks0} -> {_task_count()} across "
+             "drop+rebuild: leaked shard threads")
+    finally:
+        monkeypatch.delenv("TDR_FAULT_PLAN", raising=False)
+        fault_plan_reset()
+        for w in worlds:
+            w.close()
+
+
+_OVERLAP_SCRIPT = """
+import socket, threading
+import numpy as np
+from rocnrdma_tpu import telemetry
+from rocnrdma_tpu.collectives.world import local_worlds
+from rocnrdma_tpu.transport.engine import TransportError
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+telemetry.enable()
+# Sized so the fold gate ENGAGES: 16 chunks per phase against an
+# 8-slot scratch window (4 channels x 2) — posting chunk i+8 requires
+# chunk i folded, so wire traffic and folds are forced to interleave
+# and the overlap below is a property of the machinery, not of lucky
+# thread timing.
+count = (32 << 20) // 4
+worlds = local_worlds(2, port)
+bufs = [(np.arange(count, dtype=np.float32) % 977) * (r + 1)
+        for r in range(2)]
+expect = (np.arange(count, dtype=np.float32) % 977) * 3
+overlapped = 0
+spans_total = 0
+events = []
+for attempt in range(3):
+    bufs = [(np.arange(count, dtype=np.float32) % 977) * (r + 1)
+            for r in range(2)]
+    ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
+          for r in range(2)]
+    [t.start() for t in ts]; [t.join() for t in ts]
+    for b in bufs:
+        assert b.tobytes() == expect.tobytes(), "result diverged"
+    assert worlds[0].ring.last_schedule == 1  # generic/windowed
+    events = telemetry.drain()
+    offs = [e for e in events if e.name == "fold_off"]
+    folds = [e for e in events if e.name == "fold"]
+    assert offs and folds, "fold offload never engaged"
+    # Pair each enqueue with the first later execution of the same
+    # chunk id: that interval is the fold span (queue wait + fold).
+    spans = []
+    for off in offs:
+        cands = [f for f in folds
+                 if f.id == off.id and f.ts_ns >= off.ts_ns]
+        if cands:
+            spans.append((off.ts_ns, min(c.ts_ns for c in cands)))
+    assert spans, "no fold_off/fold pairs matched"
+    wire_ts = [e.ts_ns for e in events
+               if e.name in ("wire_tx", "wire_rx")]
+    overlapped += sum(1 for (a, b) in spans
+                      if any(a <= t <= b for t in wire_ts))
+    spans_total += len(spans)
+    # Lane split: chunk completions ride QP lanes; fold/fold_off ride
+    # helper-thread lanes disjoint from them.
+    qp_lanes = {e.qp for e in events
+                if e.name in ("post_recv", "wc") and e.qp}
+    fold_lanes = {e.qp for e in offs + folds}
+    assert fold_lanes and not (fold_lanes & qp_lanes), \
+        (qp_lanes, fold_lanes)
+    shard_lanes = {e.qp for e in events if e.name == "shard"}
+    assert shard_lanes, "no shard-thread lanes recorded"
+    if overlapped:
+        break
+for w in worlds:
+    w.close()
+assert overlapped > 0, \
+    "no fold span overlapped any wire event: folds serialized"
+print("OVERLAP_OK spans=%d overlapped=%d" % (spans_total, overlapped))
+"""
+
+
+def test_fold_spans_on_shard_threads_overlap_wire():
+    """Flight-recorder proof of the tentpole's overlap claim: with
+    sharded progress and fold offload on the striped windowed
+    schedule, FOLD_OFF→FOLD spans (enqueue on a shard thread →
+    execution on a fold worker) OVERLAP wire_tx/wire_rx events of the
+    same collective — folds run while the wire moves, instead of the
+    poll loop serializing them (BENCH_r06's occupancy-0.0 defect).
+    Also pins the lane split: fold events ride helper-thread tracks,
+    never the QP lanes the chunks complete on. Runs in a SUBPROCESS:
+    the fold pool is a process-wide singleton sized at first use, so
+    the forced TDR_FOLD_THREADS can only take effect in a fresh
+    process."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        "TDR_RING_CHANNELS": "4",
+        "TDR_PROGRESS_SHARDS": "2",
+        "TDR_FOLD_THREADS": "2",
+        "TDR_NO_RECV_REDUCE": "1",  # windowed → fold pool
+        "TDR_RING_CHUNK": str(1 << 20),
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.pop("TDR_TELEMETRY", None)  # script enables it itself
+    run = subprocess.run([sys.executable, "-c", _OVERLAP_SCRIPT],
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+    out = run.stdout + run.stderr
+    assert run.returncode == 0, out[-3000:]
+    assert "OVERLAP_OK" in out, out[-3000:]

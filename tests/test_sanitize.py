@@ -41,10 +41,11 @@ def _libasan_path():
 
 
 _SAN_SCRIPT = """
-import socket, threading
+import os, socket, threading
 import numpy as np
 from rocnrdma_tpu import telemetry
 from rocnrdma_tpu.collectives.world import local_worlds
+from rocnrdma_tpu.transport.engine import native_counters
 s = socket.socket(); s.bind(("127.0.0.1", 0))
 port = s.getsockname()[1]; s.close()
 worlds = local_worlds(2, port)
@@ -55,6 +56,20 @@ ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
 [t.start() for t in ts]; [t.join() for t in ts]
 for b in bufs:
     np.testing.assert_array_equal(b, np.full(65536, 3.0, np.float32))
+# Second pass: the SHARDED progress engine over the windowed-scratch
+# schedule (TDR_PROGRESS_SHARDS=2 is in the env; the 32 KiB ring
+# chunk makes the runs big enough in chunks to engage the shards) —
+# the per-channel locks, the one-condvar watermark hub, shard
+# spawn/join, and the fold workers all get the ASan+UBSan sweep.
+os.environ["TDR_NO_RECV_REDUCE"] = "1"
+bufs = [np.full(65536, float(r + 1), dtype=np.float32) for r in range(2)]
+ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
+      for r in range(2)]
+[t.start() for t in ts]; [t.join() for t in ts]
+for b in bufs:
+    np.testing.assert_array_equal(b, np.full(65536, 3.0, np.float32))
+assert native_counters()["progress.wc"] > 0, \\
+    "sharded progress engine never engaged under the sanitizer"
 # Telemetry ran under ASan+UBSan too (TDR_TELEMETRY=1 in the env):
 # the recorder must have captured the run, and drain + export must be
 # clean under the sanitizer as well.
@@ -94,6 +109,14 @@ def test_sanitized_sealed_world2_allreduce():
         # Run the flight recorder's event paths under the sanitizer
         # too — every emit/drain/histogram touch gets swept.
         "TDR_TELEMETRY": "1",
+        # Force the sharded progress engine + fold workers (both
+        # auto-degrade to 0 on the 1-core CI class) and a chunk size
+        # small enough that the 256 KiB test buffer spans several
+        # chunks per phase — the shard spawn/poll/join machinery must
+        # actually run under the sanitizer, not gate itself off.
+        "TDR_PROGRESS_SHARDS": "2",
+        "TDR_FOLD_THREADS": "2",
+        "TDR_RING_CHUNK": "32768",
     })
     run = subprocess.run([sys.executable, "-c", _SAN_SCRIPT],
                          capture_output=True, text=True, timeout=300,

@@ -177,11 +177,17 @@ def test_ring_bounded_under_soak_riders():
 
 
 def test_histogram_bucket_math():
-    """Log2 bucket edges and percentile estimates, Python vs native."""
+    """Log2 bucket edges and percentile estimates, Python vs native —
+    octave view AND the fine (log2 × 8) rows the percentiles now read
+    (the BENCH_r06 saturation fix: estimates are real numbers, not
+    octave edges)."""
     from rocnrdma_tpu.telemetry.recorder import (bucket_upper,
+                                                 fine_bucket_upper,
                                                  hist_percentile)
+    from rocnrdma_tpu.transport.engine import (telemetry_hist_fine_buckets,
+                                               telemetry_hist_fine_upper)
 
-    # Upper edges: bucket b holds [2^(b-1), 2^b).
+    # Octave upper edges: bucket b holds [2^(b-1), 2^b).
     assert bucket_upper(0) == 0
     assert bucket_upper(1) == 1
     assert bucket_upper(13) == 8191
@@ -192,8 +198,28 @@ def test_histogram_bucket_math():
     assert hist_percentile(buckets, 99) == bucket_upper(10)
     assert hist_percentile([0] * 64, 50) == 0
 
-    # Native bucket assignment: a 4096-byte op lands in bucket 13
-    # (4096.bit_length() == 13) of chunk_bytes.
+    # Fine edges: values 0..15 are exact; above that 8 sub-buckets per
+    # octave, and the PYTHON mirror must agree with the NATIVE edge
+    # function bucket-for-bucket (the percentile math reads these).
+    nfine = telemetry_hist_fine_buckets()
+    assert nfine >= 496
+    for idx in list(range(0, 48)) + [80, 81, 87, 100, 495]:
+        assert fine_bucket_upper(idx) == telemetry_hist_fine_upper(idx), idx
+    assert fine_bucket_upper(15) == 15
+    assert fine_bucket_upper(16) == 17   # first sub-bucket of [16, 32)
+    # Sub-octave percentiles: a fine row concentrated at ~5000 (octave
+    # [4096, 8192)) reports an edge INSIDE the octave, not 8191 — the
+    # saturation signature this fix kills.
+    fine = [0] * nfine
+    # 5000 has bit_length 13, sub = (5000 >> 9) & 7 = 1 -> idx 81.
+    fine[81] = 10
+    p = hist_percentile(fine, 50)
+    assert p == fine_bucket_upper(81) == 5119  # inside [4096, 8192)
+    assert p != bucket_upper(13)
+
+    # Native bucket assignment: a 4096-byte op lands in octave 13
+    # (4096.bit_length() == 13) of chunk_bytes — and in fine bucket 80
+    # (sub-bucket 0 of that octave).
     telemetry.enable()
     e1, e2 = Engine("emu"), Engine("emu")
     a, b = loopback_pair(e1, free_port(), e2)
@@ -204,6 +230,42 @@ def test_histogram_bucket_math():
     hist = telemetry.histograms()
     assert hist["chunk_bytes"][4096 .bit_length()] >= 1
     assert sum(hist["chunk_lat_us"]) >= 1
+    from rocnrdma_tpu.transport.engine import telemetry_histograms_fine
+
+    fine_h = telemetry_histograms_fine()
+    assert fine_h["chunk_bytes"][80] >= 1
+    # The folded octave view is exactly the fine view summed.
+    assert sum(fine_h["chunk_bytes"]) == sum(hist["chunk_bytes"])
+
+
+def test_snapshot_percentiles_not_saturated():
+    """snapshot() percentiles come from the FINE rows: they must equal
+    a recomputation from telemetry_histograms_fine() (never the coarse
+    octave rows), so a spread of real latencies cannot collapse onto
+    one octave upper edge — the BENCH_r06 record pinned p50/p90/p99 at
+    8191/32767/65535 because the estimator had octave resolution."""
+    from rocnrdma_tpu.telemetry.recorder import hist_percentiles
+    from rocnrdma_tpu.transport.engine import telemetry_histograms_fine
+
+    telemetry.enable()
+    e1, e2 = Engine("emu"), Engine("emu")
+    a, b = loopback_pair(e1, free_port(), e2)
+    try:
+        for i in range(20):  # a spread of op sizes -> a spread of lats
+            _send_recv(a, b, e1, e2, nbytes=1024 << (i % 6), wr=i + 1)
+    finally:
+        a.close(); b.close(); e1.close(); e2.close()
+    snap = telemetry.snapshot()
+    fine = telemetry_histograms_fine()
+    for name, buckets in fine.items():
+        assert snap["percentiles"][name] == hist_percentiles(buckets), name
+    # chunk_bytes spans octaves with sub-octave occupancy: its fine
+    # row must occupy more buckets than its octave fold — the extra
+    # resolution is real, not relabeled.
+    octave = snap["histograms"]["chunk_bytes"]
+    occupied_fine = sum(1 for v in fine["chunk_bytes"] if v)
+    occupied_oct = sum(1 for v in octave if v)
+    assert occupied_fine >= occupied_oct
 
 
 def _world2_run():
@@ -281,7 +343,9 @@ def test_counter_registry_and_clock_anchor():
     assert {"integrity.sealed", "integrity.verified", "integrity.failed",
             "integrity.retransmitted", "fault.seen", "fault.hits",
             "copy.nt_bytes", "copy.plain_bytes", "telemetry.recorded",
-            "telemetry.dropped"} <= names
+            "telemetry.dropped", "fold.jobs", "fold.busy_us",
+            "fold.pending", "progress.shards", "progress.wakeups",
+            "progress.wc"} <= names
     from rocnrdma_tpu.telemetry.recorder import anchor
 
     a = anchor()

@@ -1,32 +1,49 @@
 #!/usr/bin/env python
-"""perf_smoke — the multi-channel + fold-offload hot path, end to end.
+"""perf_smoke — the sharded-progress + fold-offload hot path, end to end.
 
 CI hook for `make perf-smoke` / `perf-smoke-san`: a world-2 allreduce
 striped over TDR_RING_CHANNELS=4 QPs per neighbor, forced onto the
-windowed-scratch schedule (TDR_NO_RECV_REDUCE=1) so the fold-offload
-pool carries the phase-1 folds, with the flight recorder on. Asserts:
+windowed-scratch schedule (TDR_NO_RECV_REDUCE=1) with the SHARDED
+progress engine (TDR_PROGRESS_SHARDS=2 — forced, because the 1-core
+CI class would otherwise auto-degrade to the legacy loop) and fold
+workers on (TDR_FOLD_THREADS=2 — same 1-core rationale), flight
+recorder on. Asserts:
 
   - the result is bitwise correct (exact-in-f32 inputs);
   - the generic schedule actually ran (last_schedule == GENERIC);
-  - the fold pool demonstrably executed jobs (or the host is 1-core
-    and the inline fallback ran — reported either way);
-  - recorded telemetry contains per-channel qp lanes for the chunks.
+  - the progress shards demonstrably carried the completions
+    (per-shard progress.* counters nonzero: threads launched AND
+    completions consumed on them);
+  - the fold pool executed jobs and its occupancy over the timed
+    window exceeded 0.5 — folds genuinely overlapped the wire instead
+    of serializing behind the poll loop (the BENCH_r06 0.0 defect);
+  - recorded telemetry contains per-channel qp lanes for the chunks
+    plus shard-thread lanes.
 
-Under the sanitized build (perf-smoke-san) this sweeps the striped
-posting paths, the fold workers, and the scratch-window recycling for
-memory errors and UB.
+Under the sanitized build (perf-smoke-san) this sweeps the sharded
+posting paths, the per-channel locks, the fold workers, and the
+scratch-window recycling for memory errors and UB.
 """
 import os
 import sys
 import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 os.environ.setdefault("TDR_RING_CHANNELS", "4")
-os.environ.setdefault("TDR_RING_CHUNK", str(256 << 10))
+# Default (4 MiB) ring chunks: MB-scale fold jobs keep the fold
+# workers saturated while the wire lands successors — tiny chunks
+# fragment the folds into sub-ms jobs whose dispatch gaps read as
+# idle pool time and understate the very overlap this smoke gates.
 os.environ["TDR_NO_RECV_REDUCE"] = "1"  # windowed scratch → fold pool
+# Force the sharded engine + fold workers: both default OFF on 1-core
+# hosts (they only preempt the single core), but this smoke's job is
+# to drive the machinery, not to win a benchmark.
+os.environ.setdefault("TDR_PROGRESS_SHARDS", "2")
+os.environ.setdefault("TDR_FOLD_THREADS", "2")
 
 import numpy as np  # noqa: E402
 
@@ -48,19 +65,33 @@ def free_port():
 
 def main() -> int:
     telemetry.enable()
-    count = (4 << 20) // 4
-    jobs_before = native_counters()["fold.jobs"]
+    # Big enough that the striped steady state dominates bootstrap and
+    # scratch warm-up: occupancy on a toy run measures setup, not the
+    # overlap this smoke exists to gate.
+    count = (64 << 20) // 4
     worlds = local_worlds(2, free_port())
     try:
         bufs = [(np.arange(count, dtype=np.float32) % 977) * (r + 1)
                 for r in range(2)]
         expect = ((np.arange(count, dtype=np.float32) % 977) * 3)
-        ts = [threading.Thread(target=worlds[r].allreduce,
-                               args=(bufs[r],)) for r in range(2)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
+
+        def run_all():
+            ts = [threading.Thread(target=worlds[r].allreduce,
+                                   args=(bufs[r],)) for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        run_all()  # warmup: registers MRs, sizes the scratch window
+        bufs = [(np.arange(count, dtype=np.float32) % 977) * (r + 1)
+                for r in range(2)]
+        # Occupancy is measured over the steady-state allreduce wall
+        # time only — bootstrap must not dilute the busy/wall ratio.
+        c_before = native_counters()
+        t0 = time.perf_counter()
+        run_all()
+        wall = time.perf_counter() - t0
         for r in range(2):
             assert bufs[r].tobytes() == expect.tobytes(), \
                 f"rank {r}: allreduce result diverged"
@@ -72,18 +103,34 @@ def main() -> int:
             w.close()
 
     workers = fold_pool_workers()
-    jobs = native_counters()["fold.jobs"] - jobs_before
-    if workers > 0:
-        assert jobs > 0, "fold pool has workers but executed no jobs"
+    c_after = native_counters()
+    jobs = c_after["fold.jobs"] - c_before["fold.jobs"]
+    busy_s = (c_after["fold.busy_us"] - c_before["fold.busy_us"]) / 1e6
+    shards = c_after["progress.shards"] - c_before["progress.shards"]
+    prog_wc = c_after["progress.wc"] - c_before["progress.wc"]
+    assert workers > 0, "fold workers were forced on but the pool is empty"
+    assert jobs > 0, "fold pool has workers but executed no jobs"
+    assert shards > 0, \
+        "sharded progress engine was forced on but launched no shards"
+    assert prog_wc > 0, \
+        "progress shards launched but consumed no completions"
+    occupancy = busy_s / wall
+    assert occupancy > 0.5, \
+        (f"fold-offload occupancy {occupancy:.3f} <= 0.5 — folds are "
+         f"serializing behind the wire again (busy {busy_s:.3f}s over "
+         f"{wall:.3f}s)")
     events = telemetry.drain()
     chunk_qps = {e.qp for e in events
                  if e.name in ("post_recv", "wc") and e.qp}
     assert len(chunk_qps) >= 4, \
         f"expected chunk events on >=4 qp lanes, saw {len(chunk_qps)}"
+    shard_lanes = {e.qp for e in events if e.name == "shard"}
+    assert shard_lanes, "no shard-thread lanes in the recording"
     telemetry.disable()
     print(f"perf-smoke OK: channels=4 windowed allreduce bitwise-correct, "
-          f"fold_workers={workers} fold_jobs={jobs} "
-          f"qp_lanes={len(chunk_qps)}")
+          f"shards={shards} shard_wc={prog_wc} fold_workers={workers} "
+          f"fold_jobs={jobs} occupancy={occupancy:.3f} "
+          f"qp_lanes={len(chunk_qps)} shard_lanes={len(shard_lanes)}")
     return 0
 
 

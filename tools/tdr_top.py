@@ -116,6 +116,20 @@ def render(snap: dict, chan_lats: "ChannelLats" = None) -> str:
                      f"p90={p.get('p90', 0):<8} p99={p.get('p99', 0)}")
     if chan_lats is not None:
         lines.extend(chan_lats.render())
+    # Sharded progress engine + fold pool at a glance: shard threads
+    # launched / completions they consumed, and the fold pool's
+    # executed-vs-queued depth. progress.wc == 0 with traffic means
+    # the legacy single-poll loop ran (TDR_PROGRESS_SHARDS=0 or a
+    # 1-core host); fold.pending stuck high with idle lanes means the
+    # fold pool, not the wire, is the bottleneck.
+    c = snap.get("counters", {})
+    lines.append("")
+    lines.append(f"progress: shards={c.get('progress.shards', 0)} "
+                 f"wc={c.get('progress.wc', 0)} "
+                 f"wakeups={c.get('progress.wakeups', 0)}  "
+                 f"fold: jobs={c.get('fold.jobs', 0)} "
+                 f"busy_us={c.get('fold.busy_us', 0)} "
+                 f"pending={c.get('fold.pending', 0)}")
     lines.append("")
     lines.append("counters:")
     counters = snap.get("counters", {})
