@@ -262,6 +262,37 @@ def windowed_fold_main(count, iters):
     }))
 
 
+def bench_trainer_overlap(quick, timeout_s=900):
+    """Backward-overlap trainer sub-bench: the world-2 bucketed train
+    loop (tools/overlap_smoke.py) in a SUBPROCESS — the smoke forces
+    its shard/channel knobs and telemetry ring sizes BEFORE import,
+    and jax must be pinned to CPU without disturbing this process.
+    Reports the measured overlap_fraction (wire events inside the
+    trainer.grads span / total wire events — best window of several,
+    all windows recorded; single windows on a 1-core host are
+    scheduler noise), the bucketed-vs-fused step times, and the wire
+    dtype the run used."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if quick:
+        env["TDR_OVERLAP_QUICK"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "overlap_smoke.py")],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=REPO, env=env)
+        for line in proc.stdout.splitlines():
+            if line.startswith("OVERLAP "):
+                out = json.loads(line[len("OVERLAP "):])
+                out["smoke_ok"] = proc.returncode == 0
+                return out
+        raise RuntimeError((proc.stderr or "no OVERLAP line")
+                           .strip()[-300:])
+    except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def bench_alltoall(count=(256 << 20) // 4, world=2, iters=3):
     """Ring all-to-all per-link bandwidth: (world-1)/2 of the buffer
     crosses each link per call (bundle-shrink schedule)."""
@@ -398,7 +429,7 @@ def write_bench_record(details, bus, tel, quick, details_path):
     never clobber the repo's official trajectory point."""
     from rocnrdma_tpu.collectives.staging import staging
 
-    rnd = os.environ.get("TDR_BENCH_ROUND", "r07")
+    rnd = os.environ.get("TDR_BENCH_ROUND", "r08")
     # Saturation check (the r06 defect this round fixes): percentiles
     # that all sit on one octave edge carry no information — with the
     # fine (log2 × 8) histograms that only happens when the recording
@@ -426,6 +457,10 @@ def write_bench_record(details, bus, tel, quick, details_path):
         # fold-offload occupancy for the world-4 ring (the tentpole's
         # TDR_RING_CHANNELS knob), plus which count the headline used.
         "allreduce_world4_vs_bound": details.get("allreduce_world4_vs_bound"),
+        # Which efficiency gate applied on THIS host (vs_bound needs
+        # >= 2 cores; see main()'s gate-honesty block) and whether the
+        # 0.85 bar was met under it.
+        "allreduce_world4_gate": details.get("allreduce_world4_gate"),
         # vs_bound charges ONLY the mandatory folds; on a 1-core host
         # the all-gather copies are equally mandatory on the same
         # core, so the single-core-attainable ratio is the honest
@@ -485,6 +520,14 @@ def write_bench_record(details, bus, tel, quick, details_path):
         "telemetry": {k: v for k, v in tel.items()
                       if k in ("events_while_disabled", "events_recorded",
                                "events_dropped")},
+        # Backward-overlap trainer (the r08 tentpole): measured
+        # overlap_fraction of the bucketed world-2 train loop — wire
+        # events inside the trainer.grads span / total wire events,
+        # best window of several (all windows inside train_step) —
+        # plus the bucketed-vs-fused step times and wire dtype.
+        "train_step_overlap_fraction": details.get(
+            "trainer_overlap", {}).get("overlap_fraction"),
+        "train_step": details.get("trainer_overlap"),
     }
     path = os.environ.get("TDR_BENCH_RECORD")
     if not path:
@@ -848,6 +891,24 @@ def main():
             w4_host_bound, 3)
         details["allreduce_world4_vs_host_bound"] = round(
             w4 / w4_host_bound, 3)
+        # Gate honesty (ROADMAP item 1): the 0.85 efficiency bar is
+        # gated on vs_bound ONLY when this host has >= 2 usable cores
+        # — on one core vs_bound >= 0.85 is ARITHMETICALLY unreachable
+        # (the AG copies share the fold core, capping it at ~0.6), so
+        # the honest gate there is vs_host_bound against what the
+        # core count allows. WHICH gate applied is recorded, so the
+        # item-1 re-validation is automatic the day CI gets its
+        # second core back: the gate flips to vs_bound by itself.
+        gate_metric = ("vs_bound" if cores >= 2 else "vs_host_bound")
+        gate_value = details.get(f"allreduce_world4_{gate_metric}")
+        details["allreduce_world4_gate"] = {
+            "metric": gate_metric,
+            "threshold": 0.85,
+            "host_cores": cores,
+            "value": gate_value,
+            "met": bool(gate_value is not None
+                        and gate_value >= 0.85),
+        }
     details.update(bench_staged(nbytes=sizes["staged_nbytes"]))
     details["sweep_write"] = bench_sweep(max_size=sizes["sweep_max"])
     # Flight-recorder sub-bench LAST among the transport benches: it
@@ -856,6 +917,9 @@ def main():
     # machine-readable record.
     tel = bench_telemetry(sizes)
     details["telemetry"] = tel
+    # Backward-overlap trainer datapoint (the r08 tentpole): bucketed
+    # async-handle train loop, wire hidden behind the backward pass.
+    details["trainer_overlap"] = bench_trainer_overlap(quick)
     if os.environ.get("TDR_BENCH_NO_TPU", "0") in ("", "0"):
         details.update(bench_tpu_details())
     else:
@@ -895,6 +959,8 @@ def main():
             "allreduce_world4_vs_host_bound"),
         "staged_pipelined_GBps": details.get("staged_pipelined_GBps"),
         "staged_serial_GBps": details.get("staged_serial_GBps"),
+        "train_step_overlap_fraction": details.get(
+            "trainer_overlap", {}).get("overlap_fraction"),
         "tpu": tpu[:160],
         "details_file": details_file,
         "bench_record": os.path.basename(record_path),

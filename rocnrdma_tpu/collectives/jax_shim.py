@@ -53,7 +53,9 @@ VA order would desynchronize the ring), then the staged groups.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
@@ -115,15 +117,65 @@ class CrossSliceAllReduce:
 
     def __init__(self, world: RingWorld,
                  exporter: Optional[MemoryExporter] = None,
-                 mean: bool = False):
+                 mean: bool = False,
+                 overlap: bool = False,
+                 bucket_bytes: Optional[int] = None,
+                 wire_dtype: Optional[str] = None):
         self.world = world
         self.exporter = exporter
         self.mean = mean
+        # Backward-overlap mode: ``start(tree)`` launches each
+        # gradient BUCKET's allreduce nonblocking the moment its
+        # leaves' D2H copies land, and ``finish()`` waits the handles
+        # — the trainer calls start inside its grads span so the wire
+        # hides behind the backward pass. ``__call__`` on an overlap
+        # shim is start+finish (identical results, no split).
+        self.overlap = bool(overlap)
+        # Bucket size in bytes for the overlap path's staged segments.
+        # None = the staged path's TDR_STAGE_CHUNK — at the default the
+        # overlap plan IS the fused plan (same segments, same digest).
+        # The effective value is digest-carried (schunk=), so ranks
+        # with divergent bucket configs fail the first collective fast.
+        self.bucket_bytes = None if bucket_bytes is None else \
+            int(bucket_bytes)
+        # Optional on-wire gradient compression (TDR_WIRE_DTYPE=bf16):
+        # f32 staged buckets are rounded to bf16 (with per-rank error
+        # feedback: this step's rounding error is added back into the
+        # next step's gradients, bounding drift) and the ring reduces
+        # the bf16 buffer — half the wire bytes. Negotiated like
+        # FEAT_SEAL at the collective layer: the wire dtype is
+        # schedule-changing, so it is digest-carried (``wire=bf16``)
+        # and mismatched ranks fail fast instead of mis-folding each
+        # other's frames; compressed frames are ordinary sealed
+        # payloads, so the CRC/NAK/retransmit ladder covers them
+        # unchanged.
+        wire = wire_dtype if wire_dtype is not None else \
+            os.environ.get("TDR_WIRE_DTYPE", "")
+        if wire in ("", "f32", "float32", None):
+            wire = None
+        elif wire != "bf16":
+            raise ValueError(f"TDR_WIRE_DTYPE={wire!r}: only 'bf16' "
+                             "(or unset) is supported")
+        if wire and not self.overlap:
+            raise ValueError("wire_dtype=bf16 requires overlap=True "
+                             "(compression rides the bucketed path)")
+        self.wire_dtype = wire
         # Persistent per-dtype staging buffers, registered with the
         # ring ONCE (front-loaded registration): steady-state steps
         # post work requests only, and the ring never sees a recycled
         # allocator address.
         self._staging: Dict[str, np.ndarray] = {}
+        # Overlap-path state: per-dtype bf16 wire buffers (compressed
+        # staging, ring-registered like _staging), per-dtype f32 error-
+        # feedback residuals (host-only, never registered), and the
+        # ring-registered bucket-slice VAs per staging key — slices
+        # are front-loaded once so steady-state bucket launches post
+        # work requests only (native registration takes the ring lock,
+        # which a per-step register would contend against the async
+        # driver's running collective).
+        self._wire_staging: Dict[str, np.ndarray] = {}
+        self._residuals: Dict[str, np.ndarray] = {}
+        self._slice_regs: Dict[str, Dict[int, int]] = {}
         # Zero-copy registration cache: (va, nbytes) -> Registration.
         # The MR is adopted by the ring; both sides are front-loaded.
         self._regs: Dict[Tuple[int, int], Any] = {}
@@ -252,11 +304,7 @@ class CrossSliceAllReduce:
         staging: ring posts go directly against the dma-buf MR."""
         self._ensure_registered(va, nbytes)
         self.world.allreduce(leaf, op)
-        if self.mean:
-            if leaf.dtype.kind in "iu":
-                leaf //= self.world.world
-            else:
-                leaf /= np.asarray(self.world.world, dtype=leaf.dtype)
+        self._apply_mean(leaf)
 
     def _coalesce(self, regions):
         """Merge adjacent same-dtype device regions (sorted by VA)
@@ -305,61 +353,22 @@ class CrossSliceAllReduce:
         # The whole cross-slice sync runs under one span: in the
         # merged flight-recorder timeline it is the bar over every
         # world.allreduce span and native chunk event the sync causes.
+        if self.overlap:
+            # Overlap shims route the plain call through the bucketed
+            # start/finish pair: identical results, one code path.
+            return self.start(tree).finish()
         with trace.span("xslice.sync", rank=self.world.rank):
             return self._sync(tree)
 
-    def _sync(self, tree):
-        import jax
-
-        leaves, treedef = jax.tree_util.tree_flatten(tree)
-        if not leaves:
-            return tree
-
-        out: List[Any] = list(leaves)
-        n_zero_copy = 0
-
-        # Zero-copy pass: device-resident leaves reduce in place.
-        # Aliased leaves (the same buffer appearing twice — tied
-        # weights) reduce once; adjacent numpy-exporter regions
-        # coalesce into single ring ops (see _coalesce); jax.Array
-        # regions run in tree order (see module docstring).
-        staged_idx: List[int] = []
-        dev_regions: List[Tuple[int, int, Any]] = []
-        jax_ops: List[Tuple[int, int, Any]] = []
-        seen: set = set()
-        used_keys: set = set()
-        for i, leaf in enumerate(leaves):
-            dev = self._device_leaf(leaf)
-            if dev is not None:
-                n_zero_copy += 1
-                if dev in seen:
-                    continue
-                seen.add(dev)
-                dev_regions.append((dev[0], dev[1], leaf))
-                continue
-            regions = self._jax_leaf_regions(leaf)
-            if regions is not None:
-                n_zero_copy += 1
-                for va, nbytes, buf in regions:
-                    if (va, nbytes) in seen:
-                        continue  # tied leaves: reduce once, in place
-                    seen.add((va, nbytes))
-                    jax_ops.append((va, nbytes, buf))
-                continue
-            staged_idx.append(i)
-        coalesced = self._coalesce(dev_regions)
-
-        # Staged groups, keyed by dtype in first-occurrence order (the
-        # same deterministic order on every rank).
-        groups: Dict[str, List[int]] = {}
-        for i in staged_idx:
-            groups.setdefault(str(leaves[i].dtype), []).append(i)
-
-        # Fail fast on SPMD divergence BEFORE posting any ring op: all
-        # ranks must run the identical op sequence (sizes, dtypes,
-        # residency) or the ring desynchronizes into a stall.
-        import hashlib
-
+    def _sched_describe(self, leaves, coalesced, jax_ops, groups,
+                        schunk: int, wire: Optional[str]) -> str:
+        """The SPMD schedule description every rank must agree on
+        (hashed into the digest ``check_schedule`` exchanges). Shared
+        verbatim by the fused and bucketed-overlap paths: with the
+        default bucket size and no wire compression the overlap plan
+        IS the fused plan, so the describe string — and therefore the
+        digest — is byte-identical (steady-state digest caches stay
+        warm across the upgrade, the acceptance pin)."""
         # The wavefront's last-RS-foldback transformation is gated on
         # BOTH neighbor QPs having negotiated foldback; a ring where
         # ranks disagree (per-rank TDR_NO_FOLDBACK) would silently
@@ -378,10 +387,12 @@ class CrossSliceAllReduce:
         # env string: two versions with TDR_RING_CHUNK unset but
         # different built-in defaults split segments into different
         # wire-chunk counts — that must fail the digest exchange, not
-        # wedge the ring mid-collective.
+        # wedge the ring mid-collective. Likewise schunk carries the
+        # EFFECTIVE staging-segment (bucket) size of the path that
+        # will run.
         sched = [f"world={self.world.world}",
                  f"chunk={ring_chunk_bytes()}",
-                 f"schunk={self._stage_chunk()}",
+                 f"schunk={schunk}",
                  f"mean={int(self.mean)}", f"wfb={wfb}",
                  f"seal={getattr(self.world, 'seal_config', '')}"]
         # Channel count is schedule-changing (chunk i rides channel
@@ -422,13 +433,87 @@ class CrossSliceAllReduce:
             "s:{}:{}".format(d, ",".join(str(int(leaves[i].size))
                                          for i in idxs))
             for d, idxs in groups.items()]
+        # The wire dtype is frame-content-changing (the ring folds
+        # bf16, half the bytes): digest-carried so a rank compressing
+        # against one that is not fails the first collective — the
+        # FEAT_SEAL-mismatch behavior at the collective layer. The
+        # uncompressed default contributes nothing (digest preserved).
+        if wire:
+            sched.append(f"wire={wire}")
         if self._step_token is not None:
             # Every rank must have stamped the same step (all set it
             # for their first post-(re)build sync); a rank that
             # restored a different checkpoint fails the digest here —
             # fatal, because batch desync is not cured by rebuilding.
             sched.append(f"step:{self._step_token}")
-        describe = " ".join(sched)
+        return " ".join(sched)
+
+    def _classify(self, leaves):
+        """Partition leaves into the deterministic op plan (the SPMD
+        contract's order): coalesced numpy-exporter device regions,
+        jax.Array zero-copy regions in tree order, staged groups keyed
+        by dtype in first-occurrence order. Aliased leaves (tied
+        weights) reduce once. NOTE: classifying jax leaves ADOPTS
+        their shard buffers (held until unhold) — callers own the
+        cleanup on failure."""
+        staged_idx: List[int] = []
+        dev_regions: List[Tuple[int, int, Any]] = []
+        jax_ops: List[Tuple[int, int, Any]] = []
+        seen: set = set()
+        n_zero_copy = 0
+        for i, leaf in enumerate(leaves):
+            dev = self._device_leaf(leaf)
+            if dev is not None:
+                n_zero_copy += 1
+                if dev in seen:
+                    continue
+                seen.add(dev)
+                dev_regions.append((dev[0], dev[1], leaf))
+                continue
+            regions = self._jax_leaf_regions(leaf)
+            if regions is not None:
+                n_zero_copy += 1
+                for va, nbytes, buf in regions:
+                    if (va, nbytes) in seen:
+                        continue  # tied leaves: reduce once, in place
+                    seen.add((va, nbytes))
+                    jax_ops.append((va, nbytes, buf))
+                continue
+            staged_idx.append(i)
+        coalesced = self._coalesce(dev_regions)
+        groups: Dict[str, List[int]] = {}
+        for i in staged_idx:
+            groups.setdefault(str(leaves[i].dtype), []).append(i)
+        return staged_idx, coalesced, jax_ops, groups, n_zero_copy
+
+    def _apply_mean(self, arr) -> None:
+        """Divide an in-place-reduced buffer by the world size (the
+        gradient-averaging epilogue of the zero-copy paths)."""
+        if not self.mean:
+            return
+        if arr.dtype.kind in "iu":
+            arr //= self.world.world
+        else:
+            arr /= np.asarray(self.world.world, dtype=arr.dtype)
+
+    def _sync(self, tree):
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not leaves:
+            return tree
+
+        out: List[Any] = list(leaves)
+        used_keys: set = set()
+        (staged_idx, coalesced, jax_ops, groups,
+         n_zero_copy) = self._classify(leaves)
+
+        # Fail fast on SPMD divergence BEFORE posting any ring op: all
+        # ranks must run the identical op sequence (sizes, dtypes,
+        # residency) or the ring desynchronizes into a stall.
+        describe = self._sched_describe(leaves, coalesced, jax_ops,
+                                        groups, self._stage_chunk(),
+                                        wire=None)
         unhold = getattr(self.exporter, "unhold", None)
         # reg_mr on a pinning engine (verbs) pins PHYSICAL pages: if
         # the allocator unmaps a freed buffer (glibc munmaps large
@@ -505,6 +590,181 @@ class CrossSliceAllReduce:
                     zero_copy=n_zero_copy, staged=len(staged_idx))
         return jax.tree_util.tree_unflatten(treedef, out)
 
+    # ------------------------------------------ bucketed overlap path
+
+    def start(self, tree) -> "_PendingSync":
+        """Backward-overlap sync: launch every ring op NONBLOCKING and
+        return a pending object whose ``finish()`` waits the handles
+        and scatters results.
+
+        Staged leaves are packed into **buckets** (segments of
+        ``bucket_bytes``, default the staged path's TDR_STAGE_CHUNK)
+        and each bucket's allreduce is started the moment its leaves'
+        D2H copies land (``copy_to_host_async`` is kicked for the
+        whole group up front) — so while bucket k rides the wire, this
+        thread is still gathering bucket k+1, and when the trainer
+        calls ``start`` inside its grads span the wire hides behind
+        the backward pass. Zero-copy regions launch async in place.
+        The op sequence (sizes, order) is identical to the fused
+        ``__call__`` plan at the default bucket size, so the schedule
+        digest is byte-identical there; handles execute in submission
+        order natively, so results are bitwise the fused path's.
+
+        With ``TDR_WIRE_DTYPE=bf16``, float32 staged buckets are
+        rounded to bf16 on the wire with per-rank error feedback (the
+        rounding error joins the next step's gradients); the wire
+        dtype is digest-carried and the compressed frames are ordinary
+        sealed payloads (CRC/NAK/retransmit unchanged).
+
+        A transport failure surfaces from ``start`` or ``finish`` as
+        the same taxonomy-classified TransportError the blocking path
+        raises — the elastic rebuild ladder applies unchanged; pending
+        handles are drained before the error propagates, so nothing
+        leaks into the rebuild.
+
+        Verbs (pinning) engines degrade to the fused synchronous path:
+        their per-step MR teardown discipline cannot outlive an async
+        handle."""
+        import jax
+
+        if self.world.engine.kind == ENGINE_VERBS:
+            # DEFERRED, not eager: the caller invokes start() inside
+            # its grads span — running the fused sync here would put
+            # every wire event inside that span and report ~1.0
+            # overlap on exactly the engine where nothing overlaps.
+            # Deferring to finish() reproduces the fused path's
+            # timing and spans faithfully.
+            return _DeferredSync(self, tree)
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not leaves:
+            return _DoneSync(tree)
+        out: List[Any] = list(leaves)
+        (staged_idx, coalesced, jax_ops, groups,
+         n_zero_copy) = self._classify(leaves)
+        describe = self._sched_describe(leaves, coalesced, jax_ops,
+                                        groups, self._bucket_chunk(),
+                                        wire=self.wire_dtype)
+        unhold = getattr(self.exporter, "unhold", None)
+        ops: List[tuple] = []  # execution-ordered plan entries
+        launched: List[Any] = []
+        used_keys: set = set()
+        with trace.span("xslice.sync_start", rank=self.world.rank,
+                        leaves=len(leaves)):
+            try:
+                check = getattr(self.world, "check_schedule", None)
+                if check is not None:
+                    check(hashlib.sha256(describe.encode()).digest(),
+                          describe)
+                self._step_token = None
+                for va, nbytes, arr in coalesced:
+                    self._ensure_registered(va, nbytes)
+                    h = self.world.allreduce_async(arr)
+                    launched.append(h)
+                    ops.append(("zc", h, arr, va))
+                    used_keys.add((va, nbytes))
+                for va, nbytes, buf in jax_ops:
+                    view = as_ndarray(
+                        va, (nbytes // np.dtype(buf.dtype).itemsize,),
+                        buf.dtype)
+                    self._ensure_registered(va, nbytes)
+                    h = self.world.allreduce_async(view)
+                    launched.append(h)
+                    ops.append(("jax", h, view, va))
+                    used_keys.add((va, nbytes))
+                for dtype_str, idxs in groups.items():
+                    self._start_staged_group(jax, leaves, dtype_str,
+                                             idxs, ops, launched)
+            except BaseException:
+                # Nothing may leak into the caller's recovery: drain
+                # every launched handle (teardown-ordering — a rebuild
+                # must not race live wire work) and release the
+                # adopted jax buffers.
+                for h in launched:
+                    try:
+                        h.wait()
+                    except Exception:
+                        pass
+                if unhold is not None:
+                    for va, _, _ in jax_ops:
+                        try:
+                            unhold(va)
+                        except Exception:
+                            pass
+                raise
+        return _PendingSync(self, jax, leaves, out, treedef, ops,
+                            used_keys, n_zero_copy, len(staged_idx))
+
+    def _start_staged_group(self, jax, leaves, dtype_str: str,
+                            idxs: List[int], ops: List[tuple],
+                            launched: List[Any]) -> None:
+        """Bucketed nonblocking launch of one dtype group: gather each
+        bucket (D2H + pack, optionally bf16-compress with error
+        feedback), then start its ring op immediately — the gather of
+        bucket k+1 overlaps the wire of bucket k."""
+        itemsize = np.dtype(dtype_str).itemsize
+        sizes = [int(leaves[i].size) for i in idxs]
+        total = int(sum(sizes))
+        buf = self._stage(dtype_str, total)
+        compress = self.wire_dtype == "bf16" and dtype_str == "float32"
+        wbuf = self._stage_wire(dtype_str, total) if compress else None
+        res = self._residual(dtype_str, total) if compress else None
+        staging.add(total * itemsize * 2)  # D2H + H2D round trip
+        trace.event("xslice.staged_group", dtype=dtype_str,
+                    bytes=total * itemsize, leaves=len(idxs),
+                    wire=self.wire_dtype or dtype_str)
+        # Kick asynchronous D2H for every device leaf up front so the
+        # per-bucket gathers find bytes already on their way.
+        for i in idxs:
+            start_copy = getattr(leaves[i], "copy_to_host_async", None)
+            if start_copy is not None:
+                try:
+                    start_copy()
+                except Exception:
+                    pass  # synchronous device_get below still works
+        segs = self._segment_plan(
+            idxs, sizes, max(1, self._bucket_chunk() // itemsize))
+        # Front-load EVERY bucket slice's MR before the first launch:
+        # registration takes the native ring lock, which would
+        # otherwise serialize behind the async driver's running
+        # collective and stall the very overlap this path exists for.
+        reg_key = ("w:" if compress else "s:") + dtype_str
+        target = wbuf if compress else buf
+        for o, n, _members in segs:
+            self._register_slice(reg_key, target[o:o + n])
+        for k, (o, n, members) in enumerate(segs):
+            # Bucket spans ride their own exporter lanes (lane=) so
+            # the gather/wire interleaving reads as parallel bars in
+            # Perfetto instead of stacking on the tracer lane.
+            with trace.span("xslice.bucket_gather", seg=k,
+                            lane=(k % 14) + 1, rank=self.world.rank,
+                            bytes=n * itemsize):
+                off = o
+                for i in members:
+                    p = np.asarray(jax.device_get(leaves[i])).reshape(-1)
+                    buf[off:off + p.size] = p
+                    off += p.size
+                if compress:
+                    seg = buf[o:o + n]
+                    # Error feedback: compress (grad + residual),
+                    # carry the new rounding error to the next step.
+                    seg += res[o:o + n]
+                    wbuf[o:o + n] = seg.astype(wbuf.dtype)  # RNE
+                    np.subtract(seg,
+                                wbuf[o:o + n].astype(np.float32),
+                                out=res[o:o + n])
+            h = self.world.allreduce_async(target[o:o + n])
+            # Hand the core to the transport for one scheduling slot:
+            # on core-starved hosts the gather loop would otherwise
+            # monopolize the CPU between launches and the just-posted
+            # bucket's wire work would only start after the LAST
+            # gather — serializing exactly the overlap this path
+            # exists for. A real NIC is separate silicon; this yield
+            # is the 1-core stand-in (sub-µs no-op elsewhere).
+            time.sleep(0)
+            launched.append(h)
+            ops.append(("seg", h, (dtype_str, o, n, list(members),
+                                   compress, k)))
+
     # ---------------------------------------------- staged pipeline
 
     def _staged_group(self, jax, leaves, out, dtype_str: str,
@@ -534,19 +794,8 @@ class CrossSliceAllReduce:
                     pass  # synchronous device_get below still works
 
         # Segment plan: consecutive leaves batched to >= chunk elems.
-        chunk_elems = max(1, self._stage_chunk() // itemsize)
-        segs: List[Tuple[int, int, List[int]]] = []
-        start, size, members = 0, 0, []
-        off = 0
-        for i, sz in zip(idxs, sizes):
-            members.append(i)
-            size += sz
-            off += sz
-            if size >= chunk_elems:
-                segs.append((start, size, members))
-                start, size, members = off, 0, []
-        if size:
-            segs.append((start, size, members))
+        segs = self._segment_plan(idxs, sizes,
+                                  max(1, self._stage_chunk() // itemsize))
 
         def gather(seg, k):
             with trace.span("xslice.stage_gather", seg=k,
@@ -662,6 +911,27 @@ class CrossSliceAllReduce:
             raise
 
     @staticmethod
+    def _segment_plan(idxs: List[int], sizes: List[int],
+                      chunk_elems: int) -> List[Tuple[int, int, List[int]]]:
+        """Batch consecutive leaves into segments of >= chunk_elems
+        elements: [(start_elem, n_elems, member_leaf_indices)]. The
+        plan is a pure function of leaf sizes and the chunk knob, both
+        digest-checked — every rank derives the identical plan."""
+        segs: List[Tuple[int, int, List[int]]] = []
+        start, size, members = 0, 0, []
+        off = 0
+        for i, sz in zip(idxs, sizes):
+            members.append(i)
+            size += sz
+            off += sz
+            if size >= chunk_elems:
+                segs.append((start, size, members))
+                start, size, members = off, 0, []
+        if size:
+            segs.append((start, size, members))
+        return segs
+
+    @staticmethod
     def _stage_chunk() -> int:
         env = os.environ.get("TDR_STAGE_CHUNK", "")
         if env:
@@ -673,18 +943,84 @@ class CrossSliceAllReduce:
                 pass
         return 16 << 20
 
+    def _bucket_chunk(self) -> int:
+        """Effective staged-segment (bucket) size in bytes for the
+        overlap path — ``bucket_bytes`` or the fused path's stage
+        chunk, so the default overlap plan IS the fused plan."""
+        return self.bucket_bytes or self._stage_chunk()
+
+    def _drop_slice_regs(self, key: str) -> set:
+        """Unregister the front-loaded bucket-slice MRs of one staging
+        buffer (call BEFORE the buffer is replaced/freed — a stale MR
+        over recycled memory is the hazard _stage documents). Returns
+        the dropped VAs: bucket 0's slice shares the buffer's base VA,
+        so the caller must not unregister the base a second time."""
+        dropped = set()
+        for va in self._slice_regs.pop(key, {}):
+            dropped.add(va)
+            try:
+                self.world.ring.drop_buffer(va)
+            except Exception:
+                pass  # ring may already be torn down
+        return dropped
+
+    def _register_slice(self, key: str, view: np.ndarray) -> None:
+        """Front-load the ring registration of one bucket slice
+        (steady-state launches then post work requests only — and
+        never take the native ring lock against the async driver's
+        running collective)."""
+        regs = self._slice_regs.setdefault(key, {})
+        va = int(view.ctypes.data)
+        if regs.get(va, 0) >= view.nbytes:
+            return
+        self.world.ring.register_buffer(view)
+        regs[va] = int(view.nbytes)
+
     def _stage(self, dtype_str: str, count: int) -> np.ndarray:
         buf = self._staging.get(dtype_str)
         if buf is None or buf.size < count:
             if buf is not None:
-                # Unpin the outgrown buffer before dropping it — a
-                # stale MR over freed memory could alias a recycled
-                # allocation (and on verbs it pins the old pages).
-                self.world.ring.unregister_buffer(buf)
+                # Unpin the outgrown buffer (and its bucket slices)
+                # before dropping it — a stale MR over freed memory
+                # could alias a recycled allocation (and on verbs it
+                # pins the old pages). Bucket 0's slice IS the base
+                # VA: skip the second unregister when it was dropped.
+                dropped = self._drop_slice_regs("s:" + dtype_str)
+                if buf.ctypes.data not in dropped:
+                    self.world.ring.unregister_buffer(buf)
             buf = np.empty(count, dtype=dtype_str)
             self._staging[dtype_str] = buf
             self.world.ring.register_buffer(buf)
         return buf
+
+    def _stage_wire(self, dtype_str: str, count: int) -> np.ndarray:
+        """Persistent bf16 wire buffer for a compressed dtype group
+        (the ring reduces THIS buffer; _staging keeps the f32 bytes
+        for gather/residual math)."""
+        import ml_dtypes
+
+        buf = self._wire_staging.get(dtype_str)
+        if buf is None or buf.size < count:
+            if buf is not None:
+                dropped = self._drop_slice_regs("w:" + dtype_str)
+                if buf.ctypes.data not in dropped:
+                    self.world.ring.unregister_buffer(buf)
+            buf = np.empty(count, dtype=ml_dtypes.bfloat16)
+            self._wire_staging[dtype_str] = buf
+            self.world.ring.register_buffer(buf)
+        return buf
+
+    def _residual(self, dtype_str: str, count: int) -> np.ndarray:
+        """Per-rank error-feedback accumulator for a compressed dtype
+        group: holds this rank's bf16 rounding error, added back into
+        the next step's gradients so quantization error does not
+        accumulate as drift. Host-only (never touches the ring);
+        reallocated (zeroed) when the group size changes."""
+        res = self._residuals.get(dtype_str)
+        if res is None or res.size != count:
+            res = np.zeros(count, dtype=np.float32)
+            self._residuals[dtype_str] = res
+        return res
 
     def set_step_token(self, step: int) -> None:
         """Stamp the NEXT schedule-digest exchange with the training
@@ -702,10 +1038,14 @@ class CrossSliceAllReduce:
     def reset_transport_cache(self) -> None:
         """Forget ring-bound state after ``RingWorld.rebuild()``: the
         new incarnation's ring starts with an empty registration
-        table, so cached staging buffers must re-register and cached
-        zero-copy MRs re-pin/re-adopt on next use. The elastic trainer
-        calls this between rebuild and retry."""
+        table, so cached staging buffers (bucket slices included) must
+        re-register and cached zero-copy MRs re-pin/re-adopt on next
+        use. The elastic trainer calls this between rebuild and retry.
+        Error-feedback residuals are rank-local training state, not
+        ring state — they survive the rebuild."""
         self._staging.clear()
+        self._wire_staging.clear()
+        self._slice_regs.clear()
         for key in list(self._regs):
             try:
                 self._drop_cached(key)
@@ -729,3 +1069,154 @@ class CrossSliceAllReduce:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class _DoneSync:
+    """Trivial pending object for paths that completed synchronously
+    (empty trees)."""
+
+    def __init__(self, result):
+        self._result = result
+
+    def finish(self):
+        return self._result
+
+
+class _DeferredSync:
+    """Pending object for the verbs (pinning) degrade: the fused
+    synchronous sync runs at ``finish()`` time — per-step MR teardown
+    cannot outlive an async handle, and running it at start() would
+    mis-attribute the whole wire to the caller's grads span."""
+
+    def __init__(self, shim: "CrossSliceAllReduce", tree):
+        self._shim = shim
+        self._tree = tree
+
+    def finish(self):
+        shim, tree = self._shim, self._tree
+        self._tree = None
+        with trace.span("xslice.sync", rank=shim.world.rank):
+            return shim._sync(tree)
+
+
+class _PendingSync:
+    """In-flight bucketed sync (``CrossSliceAllReduce.start``).
+
+    Holds the execution-ordered plan and its collective handles.
+    ``finish()`` waits the handles IN ORDER — scattering bucket k back
+    to its leaves (decompress, mean, device_put) the moment its wire
+    work lands, while later buckets are still in flight — and returns
+    the reduced tree. On a transport failure the remaining handles are
+    drained and adopted buffers released before the first error
+    re-raises, so the elastic rebuild ladder sees the same clean state
+    the blocking path leaves."""
+
+    def __init__(self, shim: CrossSliceAllReduce, jax, leaves, out,
+                 treedef, ops, used_keys, n_zero_copy: int,
+                 n_staged: int):
+        self._shim = shim
+        self._jax = jax
+        self._leaves = leaves
+        self._out = out
+        self._treedef = treedef
+        self._ops = ops
+        self._used_keys = used_keys
+        self._n_zero_copy = n_zero_copy
+        self._n_staged = n_staged
+        self._result = None
+        self._done = False
+
+    def _scatter(self, dtype_str: str, o: int, n: int,
+                 members: List[int], compress: bool, k: int) -> None:
+        shim, jax, leaves, out = (self._shim, self._jax, self._leaves,
+                                  self._out)
+        buf = shim._staging[dtype_str]
+        itemsize = np.dtype(dtype_str).itemsize
+        with trace.span("xslice.bucket_scatter", seg=k,
+                        lane=(k % 14) + 1, rank=shim.world.rank,
+                        bytes=n * itemsize):
+            if compress:
+                # Decompress the reduced bf16 wire bytes back into the
+                # f32 staging slice the scatter below reads.
+                wbuf = shim._wire_staging[dtype_str]
+                np.copyto(buf[o:o + n],
+                          wbuf[o:o + n].astype(np.float32))
+            off = o
+            for i in members:
+                piece = buf[off:off + leaves[i].size]
+                off += leaves[i].size
+                fresh = np.empty(np.shape(leaves[i]), dtype=piece.dtype)
+                flat = fresh.reshape(-1)
+                if not shim.mean:
+                    np.copyto(flat, piece)
+                elif piece.dtype.kind in "iu":
+                    np.floor_divide(piece, shim.world.world, out=flat)
+                else:
+                    np.divide(piece,
+                              np.asarray(shim.world.world,
+                                         dtype=piece.dtype),
+                              out=flat)
+                if isinstance(leaves[i], np.ndarray):
+                    out[i] = fresh
+                else:
+                    out[i] = jax.device_put(fresh, leaves[i].sharding)
+
+    def finish(self):
+        """Wait every handle (in submission order), scatter, and
+        return the reduced tree. Idempotent after success."""
+        if self._done:
+            return self._result
+        shim = self._shim
+        unhold = getattr(shim.exporter, "unhold", None)
+        with trace.span("xslice.sync_finish", rank=shim.world.rank):
+            for idx, op in enumerate(self._ops):
+                try:
+                    if op[0] == "zc":
+                        _, h, arr, _va = op
+                        h.wait()
+                        shim._apply_mean(arr)
+                    elif op[0] == "jax":
+                        _, h, view, va = op
+                        h.wait()
+                        shim._apply_mean(view)
+                        if unhold is not None:
+                            try:
+                                # Steady state: let XLA reuse the
+                                # buffer next step so the registration
+                                # cache converges (see TPUExporter).
+                                unhold(va)
+                            except Exception:
+                                pass
+                    else:  # ("seg", handle, payload)
+                        _, h, payload = op
+                        h.wait()
+                        self._scatter(*payload)
+                except BaseException:
+                    # Drain everything still in flight and release the
+                    # remaining adopted buffers, THEN re-raise the
+                    # first failure for the recovery ladder.
+                    if op[0] == "jax" and unhold is not None:
+                        try:
+                            unhold(op[3])
+                        except Exception:
+                            pass
+                    for later in self._ops[idx + 1:]:
+                        try:
+                            later[1].wait()
+                        except Exception:
+                            pass
+                        if later[0] == "jax" and unhold is not None:
+                            try:
+                                unhold(later[3])
+                            except Exception:
+                                pass
+                    self._done = True
+                    raise
+            self._done = True
+            shim._evict_cache(self._used_keys)
+            trace.event("xslice.allreduce", leaves=len(self._leaves),
+                        zero_copy=self._n_zero_copy,
+                        staged=self._n_staged)
+            self._result = self._jax.tree_util.tree_unflatten(
+                self._treedef, self._out)
+        return self._result

@@ -56,12 +56,75 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from rocnrdma_tpu.transport.engine import (Engine, QueuePair, Ring, RED_SUM,
-                                           TransportError,
+                                           RingOp, TransportError,
                                            note_fault_injections,
                                            note_integrity,
                                            ring_channels_default,
                                            seal_retry_budget)
 from rocnrdma_tpu.utils.trace import trace
+
+
+class CollectiveHandle:
+    """Handle for a nonblocking collective started with
+    :meth:`RingWorld.allreduce_async`.
+
+    ``wait()`` blocks until the wire work completes and raises the
+    taxonomy-classified :class:`TransportError` on failure — the same
+    error surface the blocking collectives have, so the elastic
+    TransportError → ``rebuild()`` ladder applies to async failures
+    unchanged. ``test()`` polls without blocking. The handle holds the
+    data buffer alive until completion; completion accounting feeds
+    ``RingWorld.pending_async`` (the handle-leak census)."""
+
+    def __init__(self, world: "RingWorld", op: RingOp, nbytes: int):
+        self._world = world
+        self._op = op
+        self._nbytes = nbytes
+        self._t0 = time.monotonic()
+        self._settled = False
+
+    @property
+    def done(self) -> bool:
+        return self._op.done
+
+    def _settle(self) -> None:
+        if not self._settled:
+            self._settled = True
+            self._world._async_live -= 1
+
+    def test(self) -> bool:
+        """True once the collective completed OK; raises on failure."""
+        if self._settled:
+            return True
+        try:
+            ok = self._op.test()
+        except TransportError:
+            self._settle()
+            raise
+        if ok:
+            self._settle()
+            trace.event("world.allreduce_done", rank=self._world.rank,
+                        bytes=self._nbytes,
+                        dur_s=time.monotonic() - self._t0)
+        return ok
+
+    def wait(self, timeout_ms: int = -1) -> None:
+        """Block until completion; raises the handle's TransportError
+        on failure. A positive expired timeout raises retryable and
+        leaves the handle live (wait again)."""
+        if self._settled:
+            return
+        try:
+            self._op.wait(timeout_ms)
+        except TransportError as e:
+            if "still in flight" in str(e):
+                raise  # handle stays live; do not settle
+            self._settle()
+            raise
+        self._settle()
+        trace.event("world.allreduce_done", rank=self._world.rank,
+                    bytes=self._nbytes,
+                    dur_s=time.monotonic() - self._t0)
 
 # wr_id tags for the schedule-digest exchange — distinct from the
 # ring's kWrRecv/kWrSend tag space (0x5245/0x5345 << 48).
@@ -210,6 +273,8 @@ class RingWorld:
         # Last ring-verified schedule digest: steady-state calls with
         # an unchanged digest skip the exchange entirely.
         self._sched_verified: bytes = b""
+        # Outstanding async collective handles (pending_async).
+        self._async_live = 0
         try:
             self._bootstrap(timeout_ms)
         except BaseException:
@@ -543,6 +608,31 @@ class RingWorld:
         with trace.span("world.allreduce", rank=self.rank,
                         bytes=int(array.nbytes)):
             self._live_ring().allreduce(array, op)
+
+    def allreduce_async(self, array, op: int = RED_SUM) -> "CollectiveHandle":
+        """Nonblocking in-place allreduce: returns a
+        :class:`CollectiveHandle` immediately; the wire work proceeds
+        on the ring's async driver + progress shards while the caller
+        computes. SPMD contract: every rank must start the same async
+        ops in the same order (ops execute in submission order, so the
+        wire sequence — and the result, bitwise — matches back-to-back
+        blocking calls). Do not run other collectives on this world
+        until every outstanding handle completed, and wait all handles
+        before ``rebuild()``/``close()`` (teardown fails pending
+        handles with a retryable error rather than wedging them)."""
+        ring = self._live_ring()
+        trace.event("world.allreduce_async", rank=self.rank,
+                    bytes=int(array.nbytes))
+        rop = ring.allreduce_async(array, op)
+        self._async_live += 1
+        return CollectiveHandle(self, rop, int(array.nbytes))
+
+    @property
+    def pending_async(self) -> int:
+        """Outstanding async collective handles on this world (handles
+        started and not yet waited/tested to completion) — the
+        handle-leak census smokes and tests assert returns to zero."""
+        return self._async_live
 
     def reduce_scatter(self, array, op: int = RED_SUM) -> slice:
         """In-place reduce-scatter; returns the element slice this

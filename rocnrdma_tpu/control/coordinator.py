@@ -488,7 +488,9 @@ class Coordinator:
         ``tdr_ctl_members``, ``tdr_ctl_rebuilds_total``,
         ``tdr_ctl_lease_expiries_total``, ``tdr_retransmit_rate``, the
         ``tdr_<registry counter>_total`` family (dots -> underscores,
-        e.g. ``tdr_integrity_retransmitted_total``), and the histogram
+        e.g. ``tdr_integrity_retransmitted_total``) — served both as
+        the per-world aggregate (``{world=}``, label shape unchanged)
+        and per member (``{world=,rank=}``) — and the histogram
         quantile series ``tdr_<hist>{...,quantile="0.99"}`` (e.g.
         ``tdr_chunk_lat_us``)."""
         with self._lock:
@@ -532,6 +534,21 @@ class Coordinator:
                                 row[b] += c
                 for k in sorted(agg):
                     lines.append(f"{self._metric_name(k)}{lab} {agg[k]}")
+                # Per-member series: the same registry counters, one
+                # series per ring slot with a rank label — a scraper
+                # can tell WHICH member's retransmit ladder is moving
+                # without losing the aggregate (whose label shape and
+                # values above are unchanged, contract-pinned). Slots
+                # keep serving their current occupant's last snapshot,
+                # exactly like the aggregate.
+                for m in sorted(w.members.values(), key=lambda m: m.rank):
+                    if not m.counters:
+                        continue
+                    rlab = f'{{world="{name}",rank="{m.rank}"}}'
+                    for k in sorted(m.counters):
+                        lines.append(
+                            f"{self._metric_name(k)}{rlab} "
+                            f"{m.counters[k]}")
                 sealed = agg.get("integrity.sealed", 0)
                 retx = agg.get("integrity.retransmitted", 0)
                 rate = (retx / sealed) if sealed else 0.0

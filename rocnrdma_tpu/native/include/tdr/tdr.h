@@ -515,6 +515,59 @@ int tdr_ring_unregister(tdr_ring *r, void *base);
 int tdr_ring_adopt_mr(tdr_ring *r, void *base, tdr_mr *mr);
 void tdr_ring_destroy(tdr_ring *r);
 
+/* ------------------------------------------------------------------ *
+ * Nonblocking ring collectives — handle-based allreduce.
+ *
+ * tdr_ring_start posts an allreduce onto the ring's async driver (one
+ * dedicated thread per ring, spawned lazily at the first start and
+ * joined at destroy) and returns immediately with a handle. Ops
+ * execute STRICTLY in submission order — submission order is the SPMD
+ * contract: every rank must start the same ops in the same order, and
+ * the driver serializes them on the ring exactly as back-to-back
+ * blocking calls would, so a mixed async/blocking fleet stays
+ * wire-compatible and results are bitwise identical to the blocking
+ * API. While an op is in flight the CALLER's thread never parks on
+ * the progress machinery (the shard threads own polling; the driver
+ * thread owns posting/consuming), which is what lets a training step
+ * overlap its backward pass with the wire.
+ *
+ * Failure is HANDLE-SCOPED: a failed op records its error on the
+ * handle; tdr_ring_wait/tdr_ring_test surface it into the calling
+ * thread's tdr_last_error slot with the same status labels as the
+ * blocking API, so the existing retryable/fatal taxonomy (and the
+ * elastic rebuild ladder above it) applies unchanged. After any async
+ * failure the driver fails subsequent queued ops fast ("aborted after
+ * earlier failure") instead of posting into a broken ring — the
+ * caller's recovery is a world rebuild, which replaces the ring.
+ *
+ * The data buffer must stay alive and untouched until the handle
+ * completes. Do not run OTHER collectives on the ring between start
+ * and wait unless every rank interleaves them identically (they would
+ * serialize correctly but a cross-rank order divergence desyncs the
+ * wire, exactly as with blocking calls from two threads).
+ *
+ * tdr_ring_op_free on a still-pending handle blocks until the op
+ * completes (every op terminates: the stall deadline bounds a wedged
+ * collective), then releases it.
+ * ------------------------------------------------------------------ */
+typedef struct tdr_ring_op tdr_ring_op;
+tdr_ring_op *tdr_ring_start(tdr_ring *r, void *data, size_t count,
+                            int dtype, int red_op);
+/* 1 = done ok, 0 = still in flight, -1 = failed (error in
+ * tdr_last_error and tdr_ring_op_error). */
+int tdr_ring_test(tdr_ring_op *op);
+/* Block until the op completes (timeout_ms < 0 = forever). 0 = done
+ * ok; -1 = failed or timed out (tdr_last_error distinguishes; a
+ * timeout leaves the op in flight and wait may be called again). */
+int tdr_ring_wait(tdr_ring_op *op, int timeout_ms);
+/* The op's recorded error ("" while pending or on success). */
+const char *tdr_ring_op_error(tdr_ring_op *op);
+/* 1 once the op completed (ok or failed). Unlike tdr_ring_test this
+ * NEVER writes the calling thread's error slot — safe from finalizer
+ * contexts that must not clobber an error another call is reading. */
+int tdr_ring_op_done(tdr_ring_op *op);
+void tdr_ring_op_free(tdr_ring_op *op);
+
 /* Which schedule the LAST tdr_ring_allreduce on this ring ran —
  * introspection for tests/benches asserting that the negotiated
  * capabilities actually selected the fused paths. */

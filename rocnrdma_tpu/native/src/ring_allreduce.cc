@@ -245,7 +245,115 @@ struct tdr_ring {
     }
     return tmp_mr;
   }
+
+  // Async driver (tdr_ring_start): one dedicated thread per ring,
+  // spawned at the first start and joined at destroy, executing
+  // queued ops strictly in submission order — submission order IS the
+  // SPMD contract, and serializing on this one thread keeps the wire
+  // sequence identical to back-to-back blocking calls. After any
+  // failure the driver fails the remaining queue fast (the ring is
+  // suspect; the recovery ladder replaces it at rebuild) instead of
+  // posting into a broken ring and eating a stall deadline per op.
+  std::mutex amu;
+  std::condition_variable acv;
+  std::deque<tdr_ring_op *> aq;
+  std::thread adrv;
+  bool adrv_up = false;   // under amu
+  bool astop = false;     // under amu
+  bool afailed = false;   // under amu: sticky for this ring's lifetime
+  std::string aerr;       // under amu
 };
+
+// Handle for one nonblocking collective (tdr_ring_start). Owned by
+// the caller; the driver only writes it under op->mu and never
+// touches it after marking done, so freeing a COMPLETED op is race-
+// free. tdr_ring_op_free on a pending op blocks until completion
+// (every op terminates — the stall deadline bounds a wedged ring).
+struct tdr_ring_op {
+  void *data = nullptr;
+  size_t count = 0;
+  int dtype = 0;
+  int red_op = 0;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;  // under mu
+  int rc = 0;         // under mu
+  std::string err;    // under mu
+};
+
+namespace {
+
+void op_complete(tdr_ring_op *op, int rc, const std::string &err) {
+  {
+    std::lock_guard<std::mutex> g(op->mu);
+    op->rc = rc;
+    op->err = err;
+    op->done = true;
+  }
+  op->cv.notify_all();
+}
+
+// The ring's async driver thread: pop in submission order, run the
+// blocking collective, publish the result on the handle. Thread-local
+// errors are bridged onto the HANDLE here — the waiting thread could
+// never read this thread's tdr_last_error slot.
+void async_driver(tdr_ring *r) {
+  for (;;) {
+    tdr_ring_op *op = nullptr;
+    bool failed = false;
+    std::string ferr;
+    {
+      std::unique_lock<std::mutex> lk(r->amu);
+      r->acv.wait(lk, [&] { return r->astop || !r->aq.empty(); });
+      if (r->aq.empty()) return;  // astop and drained
+      op = r->aq.front();
+      r->aq.pop_front();
+      failed = r->afailed;
+      if (failed) ferr = r->aerr;
+    }
+    if (failed) {
+      op_complete(op, -1,
+                  "ring async: aborted after earlier failure (" + ferr +
+                      ")");
+      continue;
+    }
+    int rc = tdr_ring_allreduce(r, op->data, op->count, op->dtype,
+                                op->red_op);
+    std::string err = rc == 0 ? std::string() : tdr::get_error();
+    if (rc != 0) {
+      std::lock_guard<std::mutex> g(r->amu);
+      r->afailed = true;
+      r->aerr = err;
+    }
+    op_complete(op, rc, err);
+  }
+}
+
+// Stop the driver and fail whatever it never started. Pending ops are
+// completed with a retryable-classed error (teardown mid-flight is a
+// transient, exactly like a connection drop) — never silently
+// dropped, so a waiting thread always wakes.
+void async_stop(tdr_ring *r) {
+  std::deque<tdr_ring_op *> orphans;
+  bool join = false;
+  {
+    std::lock_guard<std::mutex> g(r->amu);
+    r->astop = true;
+    orphans.swap(r->aq);
+    join = r->adrv_up;
+  }
+  r->acv.notify_all();
+  for (tdr_ring_op *op : orphans)
+    op_complete(op, -1,
+                "ring destroyed (connection down for pending async op)");
+  if (join) {
+    r->adrv.join();
+    std::lock_guard<std::mutex> g(r->amu);
+    r->adrv_up = false;
+  }
+}
+
+}  // namespace
 
 namespace {
 RingTelScope::RingTelScope(tdr_ring *r, uint64_t bytes) {
@@ -320,10 +428,109 @@ size_t tdr_ring_chunk_bytes(void) { return ring_chunk_bytes(); }
 
 void tdr_ring_destroy(tdr_ring *r) {
   if (!r) return;
+  // Quiesce the async driver FIRST: a queued op must fail fast (its
+  // waiter wakes with a retryable error), and a running op must
+  // finish before the MRs it posts against are deregistered below.
+  async_stop(r);
   for (auto &kv : r->registered)
     if (!r->borrowed.count(kv.first)) tdr_dereg_mr(kv.second);
   if (r->tmp_mr) tdr_dereg_mr(r->tmp_mr);
   delete r;
+}
+
+tdr_ring_op *tdr_ring_start(tdr_ring *r, void *data, size_t count,
+                            int dtype, int red_op) {
+  if (!r || !data) {
+    tdr::set_error("ring_start: null ring or data");
+    return nullptr;
+  }
+  if (dtype_size(dtype) == 0) {
+    tdr::set_error("ring: bad dtype");
+    return nullptr;
+  }
+  if (dtype == TDR_DT_U8) {
+    tdr::set_error(
+        "ring_start: u8 is byte-transport only (no fold semantics)");
+    return nullptr;
+  }
+  auto *op = new tdr_ring_op();
+  op->data = data;
+  op->count = count;
+  op->dtype = dtype;
+  op->red_op = red_op;
+  {
+    std::lock_guard<std::mutex> g(r->amu);
+    if (r->astop) {
+      tdr::set_error("ring_start: ring is being destroyed");
+      delete op;
+      return nullptr;
+    }
+    if (!r->adrv_up) {
+      r->adrv = std::thread(async_driver, r);
+      r->adrv_up = true;
+    }
+    r->aq.push_back(op);
+  }
+  r->acv.notify_all();
+  return op;
+}
+
+int tdr_ring_test(tdr_ring_op *op) {
+  if (!op) {
+    tdr::set_error("ring_test: null op");
+    return -1;
+  }
+  std::lock_guard<std::mutex> g(op->mu);
+  if (!op->done) return 0;
+  if (op->rc != 0) {
+    tdr::set_error(op->err);
+    return -1;
+  }
+  return 1;
+}
+
+int tdr_ring_wait(tdr_ring_op *op, int timeout_ms) {
+  if (!op) {
+    tdr::set_error("ring_wait: null op");
+    return -1;
+  }
+  std::unique_lock<std::mutex> lk(op->mu);
+  if (timeout_ms < 0) {
+    op->cv.wait(lk, [&] { return op->done; });
+  } else if (!op->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                              [&] { return op->done; })) {
+    tdr::set_error("ring_wait: timeout waiting for async collective");
+    return -1;
+  }
+  if (op->rc != 0) {
+    tdr::set_error(op->err);
+    return -1;
+  }
+  return 0;
+}
+
+const char *tdr_ring_op_error(tdr_ring_op *op) {
+  if (!op) return "";
+  std::lock_guard<std::mutex> g(op->mu);
+  return op->done && op->rc != 0 ? op->err.c_str() : "";
+}
+
+int tdr_ring_op_done(tdr_ring_op *op) {
+  if (!op) return 0;
+  std::lock_guard<std::mutex> g(op->mu);
+  return op->done ? 1 : 0;
+}
+
+void tdr_ring_op_free(tdr_ring_op *op) {
+  if (!op) return;
+  {
+    // A pending op is still owned by the driver: block until it
+    // completes (bounded by the collective's own stall deadline)
+    // rather than freeing memory another thread will write.
+    std::unique_lock<std::mutex> lk(op->mu);
+    op->cv.wait(lk, [&] { return op->done; });
+  }
+  delete op;
 }
 
 // Pre-register a buffer whose lifetime the caller guarantees to
@@ -1708,6 +1915,49 @@ struct OwnedMrGuard {
   }
 };
 
+// Per-call-MR teardown race fix (documented by PR 7's conn-drop test):
+// when a collective FAILS on this rank while its data MR was
+// per-call-registered, returning immediately deregisters that MR while
+// the peer may still have landings in flight on the surviving
+// channels — those landings then complete the PEER's sends with
+// LOC_ACCESS_ERR (non-retryable by taxonomy) even though the
+// underlying fault was a transient drop. Defer the invalidation: keep
+// the MR alive through a bounded quiet-interval drain, discarding
+// completions until the QPs go quiet (the owed in-flight landings have
+// materialized, or the sockets are dead and nothing more can arrive),
+// and only then let OwnedMrGuard dereg. Success paths never get here —
+// a finished schedule consumed every owed completion — so the steady
+// state pays nothing; the discarded completions belong to the failed
+// collective, which the caller recovers from by rebuilding.
+void quiesce_before_dereg(tdr_ring *r, bool owned) {
+  if (!owned) return;
+  using clock = std::chrono::steady_clock;
+  const auto quiet = std::chrono::milliseconds(100);
+  const auto deadline =
+      clock::now() +
+      std::chrono::milliseconds(std::min(2000, ring_timeout_ms()));
+  auto quiet_dl = clock::now() + quiet;
+  tdr_wc wc[16];
+  const bool same_qp = (r->lefts[0] == r->rights[0]);
+  while (clock::now() < deadline && clock::now() < quiet_dl) {
+    int got = 0;
+    for (tdr_qp *qp : r->lefts) {
+      int n = tdr_poll(qp, wc, 16, 0);
+      if (n > 0) got += n;
+    }
+    if (!same_qp) {
+      for (tdr_qp *qp : r->rights) {
+        int n = tdr_poll(qp, wc, 16, 0);
+        if (n > 0) got += n;
+      }
+    }
+    if (got)
+      quiet_dl = clock::now() + quiet;
+    else
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
+}
+
 // The generic schedule's two phases, shared verbatim between
 // allreduce and the standalone reduce_scatter/all_gather so the
 // documented bit-for-bit composition identity cannot drift.
@@ -1830,7 +2080,9 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
     // both ranks take the same branch here by construction.
     f.use_fb = tdr_qp_has_send_foldback(r->right);
     r->last_sched = f.use_fb ? TDR_SCHED_FUSED2_FB : TDR_SCHED_FUSED2;
-    return tel.finish(f.run());
+    int rc = f.run();
+    if (rc != 0) quiesce_before_dereg(r, owned);
+    return tel.finish(rc);
   }
 
   // General wavefront path: the full 2(world-1)-step schedule
@@ -1901,13 +2153,17 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                             fold, 0});
     }
     r->last_sched = TDR_SCHED_WAVEFRONT;
-    return tel.finish(wf.run());
+    int rc = wf.run();
+    if (rc != 0) quiesce_before_dereg(r, owned);
+    return tel.finish(rc);
   }
 
   r->last_sched = TDR_SCHED_GENERIC;
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};
-  if (run_rs_phase(pipe, r, seg_off, seg_len) != 0) return tel.finish(-1);
-  return tel.finish(run_ag_phase(pipe, r, seg_off, seg_len));
+  int rc = run_rs_phase(pipe, r, seg_off, seg_len);
+  if (rc == 0) rc = run_ag_phase(pipe, r, seg_off, seg_len);
+  if (rc != 0) quiesce_before_dereg(r, owned);
+  return tel.finish(rc);
 }
 
 // ------------------------------------------------------------------
@@ -1960,7 +2216,9 @@ int tdr_ring_reduce_scatter(tdr_ring *r, void *data, size_t count,
     return tel.finish(-1);
   }
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, red_op, esz};
-  return tel.finish(run_rs_phase(pipe, r, seg_off, seg_len));
+  int rc = run_rs_phase(pipe, r, seg_off, seg_len);
+  if (rc != 0) quiesce_before_dereg(r, owned);
+  return tel.finish(rc);
 }
 
 int tdr_ring_all_gather(tdr_ring *r, void *data, size_t count, int dtype) {
@@ -1986,7 +2244,9 @@ int tdr_ring_all_gather(tdr_ring *r, void *data, size_t count, int dtype) {
   OwnedMrGuard guard{dmr, owned};
   (void)guard;
   StepPipe pipe{r, dmr, static_cast<char *>(data), dtype, TDR_RED_SUM, esz};
-  return tel.finish(run_ag_phase(pipe, r, seg_off, seg_len));
+  int rc = run_ag_phase(pipe, r, seg_off, seg_len);
+  if (rc != 0) quiesce_before_dereg(r, owned);
+  return tel.finish(rc);
 }
 
 namespace {

@@ -287,13 +287,28 @@ class Trainer:
                     if stamp is not None:
                         stamp(self.global_step)
                     self._stamp_sync = False
+                # Backward-overlap: a sync layer exposing start()
+                # (CrossSliceAllReduce(overlap=True)) launches each
+                # gradient bucket's allreduce INSIDE the grads span —
+                # as its leaves' D2H copies land — so the wire hides
+                # behind the backward pass, and the sync span shrinks
+                # to waiting the last handles + scatter. The
+                # flight-recorder overlap_fraction (wire events inside
+                # trainer.grads / total wire) measures exactly this.
+                overlap = (getattr(self.cross_slice_sync, "overlap",
+                                   False)
+                           and hasattr(self.cross_slice_sync, "start"))
+                pending = None
                 with trace.span("trainer.grads", step=step_no):
                     loss, grads = self._jit_grads(self.params, tokens)
+                    if overlap:
+                        pending = self.cross_slice_sync.start(grads)
                 # The cross-slice hop: grads averaged across slices
                 # over the RDMA transport (staged fallback accounts
                 # its bytes), then applied locally.
                 with trace.span("trainer.sync", step=step_no):
-                    grads = self.cross_slice_sync(grads)
+                    grads = (pending.finish() if pending is not None
+                             else self.cross_slice_sync(grads))
                 # Quarantine check BEFORE apply: gradients that passed
                 # the transport's integrity seal but came back
                 # non-finite would poison params on apply — with the

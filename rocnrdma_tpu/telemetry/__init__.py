@@ -29,13 +29,13 @@ Typical use::
 
 from rocnrdma_tpu.telemetry.recorder import (  # noqa: F401
     TelEvent, counters, disable, drain, enable, enabled, histograms,
-    hist_percentile, hist_percentiles, python_events, reset, snapshot,
-    start_snapshot_writer, timeline)
+    hist_percentile, hist_percentiles, overlap_fraction, python_events,
+    reset, snapshot, start_snapshot_writer, timeline)
 from rocnrdma_tpu.telemetry.perfetto import export_trace  # noqa: F401
 
 __all__ = [
     "TelEvent", "counters", "disable", "drain", "enable", "enabled",
     "export_trace", "histograms", "hist_percentile", "hist_percentiles",
-    "python_events", "reset", "snapshot", "start_snapshot_writer",
-    "timeline",
+    "overlap_fraction", "python_events", "reset", "snapshot",
+    "start_snapshot_writer", "timeline",
 ]

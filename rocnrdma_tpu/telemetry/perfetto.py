@@ -66,14 +66,25 @@ def export_trace(path: Optional[str] = None,
                                             e.name, e.id)):
         ts_us = ev.ts_ns / 1000.0
         pid = ev.engine if ev.source == "native" else 0
-        tid = ev.qp if ev.source == "native" else 0
+        if ev.source == "native":
+            tid = ev.qp
+        else:
+            # Python spans may claim their own lane (a ``lane=`` field
+            # — the bucketed sync stamps one per bucket), so
+            # concurrent bucket gather/scatter bars render as parallel
+            # lanes instead of stacking on the tracer lane.
+            try:
+                tid = int(ev.fields.get("lane", 0) or 0)
+            except (TypeError, ValueError):
+                tid = 0
         seen_pids.setdefault(pid)
         seen_tids.setdefault((pid, tid))
         if ev.source == "native":
             lane_names.setdefault((pid, tid), set()).add(ev.name)
         if ev.source == "python" and "dur_s" in ev.fields:
             dur_us = float(ev.fields["dur_s"]) * 1e6
-            args = {k: v for k, v in ev.fields.items() if k != "dur_s"}
+            args = {k: v for k, v in ev.fields.items()
+                    if k not in ("dur_s", "lane")}
             trace_events.append({
                 "name": ev.name, "ph": "X", "pid": pid, "tid": tid,
                 "ts": ts_us - dur_us, "dur": dur_us, "args": args,
@@ -102,6 +113,8 @@ def export_trace(path: Optional[str] = None,
         kinds = lane_names.get((pid, tid), set())
         if pid == 0 and tid == 0:
             name = "tracer"
+        elif pid == 0:
+            name = f"lane{tid}"  # python span lanes (bucket bars)
         elif tid == 0:
             name = "engine"
         elif "shard" in kinds:

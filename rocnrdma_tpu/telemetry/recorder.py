@@ -182,6 +182,57 @@ def hist_percentiles(buckets: Sequence[int],
     return {f"p{q:g}": hist_percentile(buckets, q) for q in qs}
 
 
+def overlap_fraction(events: Optional[Sequence[TelEvent]] = None,
+                     span: str = "trainer.grads",
+                     wire: Sequence[str] = ("wire_tx", "wire_rx")
+                     ) -> Dict[str, Any]:
+    """Measured backward-overlap of a recorded window: the fraction of
+    native WIRE events (frame tx/rx instants) whose timestamps fall
+    inside any ``span`` Python span — for the default
+    ``trainer.grads``, the share of wire traffic that happened while
+    the trainer was still inside its backward/gather phase, i.e. the
+    wire time the bucketed overlap actually hid. 0 = fully serial
+    (every frame moved after the grads span closed, the fused-blocking
+    shape); 1 = every frame moved under the backward pass. Wire events
+    are instants of near-uniform chunk size, so the event-count ratio
+    is a faithful time-share estimate.
+
+    ``events`` is a merged timeline (``telemetry.timeline()``); when
+    None the native ring is drained now. Spans overlapping across
+    steps are merged before counting."""
+    if events is None:
+        events = timeline()
+    spans: List[List[int]] = []
+    for e in events:
+        if e.source == "python" and e.name == span and "dur_s" in e.fields:
+            end = int(e.ts_ns)
+            spans.append([end - int(float(e.fields["dur_s"]) * 1e9), end])
+    wire_ts = sorted(int(e.ts_ns) for e in events
+                     if e.source == "native" and e.name in wire)
+    spans.sort()
+    merged: List[List[int]] = []
+    for s in spans:
+        if merged and s[0] <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], s[1])
+        else:
+            merged.append(list(s))
+    inside = 0
+    i = 0
+    for ts in wire_ts:
+        while i < len(merged) and merged[i][1] < ts:
+            i += 1
+        if i < len(merged) and merged[i][0] <= ts:
+            inside += 1
+    total = len(wire_ts)
+    return {
+        "span": span,
+        "spans": len(spans),
+        "wire_events": total,
+        "wire_in_span": inside,
+        "overlap_fraction": round(inside / total, 4) if total else 0.0,
+    }
+
+
 def snapshot() -> Dict[str, Any]:
     """Counters + histograms + latency percentiles in one JSONable
     dict — what ``tdr_top`` renders and the bench record embeds.

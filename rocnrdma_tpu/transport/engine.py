@@ -226,6 +226,20 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_allreduce.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
     ]
+    lib.tdr_ring_start.restype = P
+    lib.tdr_ring_start.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.tdr_ring_test.restype = ctypes.c_int
+    lib.tdr_ring_test.argtypes = [P]
+    lib.tdr_ring_wait.restype = ctypes.c_int
+    lib.tdr_ring_wait.argtypes = [P, ctypes.c_int]
+    lib.tdr_ring_op_error.restype = ctypes.c_char_p
+    lib.tdr_ring_op_error.argtypes = [P]
+    lib.tdr_ring_op_done.restype = ctypes.c_int
+    lib.tdr_ring_op_done.argtypes = [P]
+    lib.tdr_ring_op_free.restype = None
+    lib.tdr_ring_op_free.argtypes = [P]
     lib.tdr_ring_reduce_scatter.restype = ctypes.c_int
     lib.tdr_ring_reduce_scatter.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
@@ -305,6 +319,7 @@ _RETRYABLE_MARKERS = (
     "fault injected",     # TDR_FAULT_PLAN transient
     "stale ring generation",  # fenced previous-incarnation traffic
     "never connected",    # rendezvous peer missing
+    "ring destroyed",     # teardown raced a pending async handle
 )
 
 
@@ -830,6 +845,85 @@ class QueuePair:
         self.close()
 
 
+class RingOp:
+    """Handle for one nonblocking ring collective (``allreduce_async``).
+
+    Holds a reference to the data buffer (the native op posts against
+    it until completion). Failure is handle-scoped: ``wait``/``test``
+    raise a :class:`TransportError` carrying the same status labels as
+    the blocking API, so the retryable/fatal taxonomy — and the
+    elastic rebuild ladder above it — applies unchanged."""
+
+    def __init__(self, handle: int, array):
+        self._h = handle
+        self._array = array  # keep the buffer alive until completion
+
+    @property
+    def done(self) -> bool:
+        """Completed (ok or failed) and released."""
+        return self._h is None
+
+    def test(self) -> bool:
+        """True when the op completed OK (and releases the handle);
+        False while still in flight; raises on a failed op."""
+        if self._h is None:
+            return True
+        rc = _load().tdr_ring_test(self._h)
+        if rc == 0:
+            return False
+        self._finish(rc)
+        return True
+
+    def wait(self, timeout_ms: int = -1) -> None:
+        """Block until the op completes (forever by default — the
+        collective's own stall deadline bounds a wedged ring). A
+        positive timeout that expires raises a retryable timeout error
+        and leaves the handle live (wait again or let close() reap)."""
+        if self._h is None:
+            return
+        rc = _load().tdr_ring_wait(self._h, int(timeout_ms))
+        if rc != 0:
+            # Distinguish a wait TIMEOUT from an op FAILURE — and
+            # re-check the op, not the error string: the collective
+            # may have completed (either way) between the native wait
+            # expiring and now, and reporting a completed-ok op as
+            # failed would tear down a world whose peers all
+            # succeeded.
+            t = _load().tdr_ring_test(self._h)
+            if t == 0:
+                raise TransportError(
+                    "timeout waiting for async collective "
+                    "(still in flight)")
+            rc = 0 if t > 0 else -1
+        self._finish(rc)
+
+    def _finish(self, rc: int) -> None:
+        """Consume the completed op: free the native handle and raise
+        the recorded, taxonomy-classified error on failure."""
+        err = ""
+        if rc != 0:
+            err = _load().tdr_ring_op_error(self._h).decode() or \
+                _load().tdr_last_error().decode() or "async collective failed"
+        h, self._h = self._h, None
+        self._array = None
+        _load().tdr_ring_op_free(h)
+        if rc != 0:
+            raise TransportError(err)
+
+    def __del__(self):
+        # Backstop only: free a COMPLETED but never-consumed op.
+        # A pending op is deliberately leaked here (op_free would
+        # block GC until the collective terminates); ring destroy
+        # fails pending ops promptly and close paths wait handles.
+        # tdr_ring_op_done, NOT tdr_ring_test: a finalizer runs at an
+        # arbitrary GC point and must never write the thread-local
+        # error slot another native call is about to read.
+        h = getattr(self, "_h", None)
+        if h is not None and _load().tdr_ring_op_done(h):
+            self._h = None
+            _load().tdr_ring_op_free(h)
+
+
 class Ring:
     """Native ring-allreduce context over neighbor QPs.
 
@@ -905,6 +999,19 @@ class Ring:
         rc = _load().tdr_ring_allreduce(_live(self._h, "ring_allreduce"),
                                         ptr, array.size, dt, op)
         _check(rc == 0, "ring_allreduce")
+
+    def allreduce_async(self, array, op: int = RED_SUM) -> "RingOp":
+        """Nonblocking allreduce: posts onto the ring's async driver
+        and returns a :class:`RingOp` immediately. Ops execute strictly
+        in submission order (the SPMD contract — every rank must start
+        the same ops in the same order), bitwise-identical to the
+        blocking call. The array must stay alive and untouched until
+        the handle completes."""
+        ptr, dt = self._array_args(array, "allreduce_async")
+        h = _load().tdr_ring_start(_live(self._h, "ring_start"),
+                                   ptr, array.size, dt, op)
+        _check(h, "ring_start")
+        return RingOp(h, array)
 
     def _array_args(self, array, what: str, need_dtype: bool = True):
         import numpy as np

@@ -504,6 +504,33 @@ def test_metrics_match_native_registry_snapshot(coord):
         assert served == snap[name], name
 
 
+def test_metrics_per_member_rank_labels(coord):
+    """Member-pushed registry series are ALSO served per ring slot
+    with a ``rank=`` label: each rank's series carries exactly its own
+    pushed snapshot (attribution — which member's retransmit ladder is
+    moving), while the aggregate ``{world=}`` series keeps its
+    contract-pinned label shape and value (the sum over slots)."""
+    client = ControlClient(coord.address)
+    views = _join_all(client, "ranked", 2)
+    base = {"integrity.sealed": 100, "integrity.retransmitted": 4}
+    other = {"integrity.sealed": 23, "integrity.retransmitted": 1}
+    client.heartbeat("ranked", 0, views[0]["incarnation"],
+                     views[0]["generation"], counters=base)
+    client.heartbeat("ranked", 1, views[1]["incarnation"],
+                     views[1]["generation"], counters=other)
+    body = client.metrics()
+    for name, pushed in (("integrity.sealed", (100, 23)),
+                         ("integrity.retransmitted", (4, 1))):
+        metric = f"tdr_{name.replace('.', '_')}_total"
+        for rank, want in enumerate(pushed):
+            served = _metric_value(
+                body, f'{metric}{{world="ranked",rank="{rank}"}}')
+            assert served == want, (name, rank)
+        # Aggregate series: unchanged label shape, sum over slots.
+        agg = _metric_value(body, f'{metric}{{world="ranked"}}')
+        assert agg == sum(pushed), name
+
+
 def test_healthz_and_unknown_path():
     coord = Coordinator(port=0, port_base=_free_port()).start()
     try:

@@ -437,16 +437,16 @@ def test_shard_threads_join_across_drop_and_rebuild(monkeypatch):
                 break
         assert any(e is not None for e in errs), \
             "drop rider never surfaced"
-        # At least one rank classifies the drop as retryable — the
-        # elastic ladder's entry point. The OTHER rank may race the
-        # first rank's teardown: these buffers are per-call
-        # registered, so the failing rank's exit deregisters its data
-        # MR while peer frames are still in flight on the surviving
-        # channels, and those land against an invalidated MR
-        # (LOC_ACCESS_ERR — not retryable by taxonomy). rebuild()
-        # below recovers either way; ring-registered steady-state
-        # buffers never hit this seam.
+        # EVERY failing rank classifies the drop as retryable. The
+        # other rank racing the first rank's teardown used to observe
+        # LOC_ACCESS_ERR here (per-call-registered buffers: the
+        # failing rank's exit deregistered its data MR while peer
+        # frames were still in flight on the surviving channels) —
+        # the native layer now defers the per-call MR teardown until
+        # the owed in-flight landings drain (quiesce_before_dereg),
+        # so the transient drop surfaces as transient on BOTH sides.
         assert any(e is not None and e.retryable for e in errs), errs
+        assert all(e is None or e.retryable for e in errs), errs
 
         monkeypatch.delenv("TDR_FAULT_PLAN")
         fault_plan_reset()

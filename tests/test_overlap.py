@@ -1,0 +1,508 @@
+"""Backward-overlap trainer path: async collective handles, gradient
+bucketing, and bf16 on-wire compression.
+
+The tentpole turned the collectives layer from call-and-block into
+handle-based: ``RingWorld.allreduce_async`` returns a
+``CollectiveHandle`` backed by the native ``tdr_ring_start/test/wait``
+API (ops execute strictly in submission order on the ring's async
+driver — the SPMD contract), ``CrossSliceAllReduce(overlap=True)``
+launches each gradient BUCKET's allreduce as its leaves' D2H copies
+land, and ``TDR_WIRE_DTYPE=bf16`` compresses f32 buckets on the wire
+with per-rank error feedback. These tests pin the properties that make
+that safe:
+
+- async results are bitwise the blocking path's, and several handles
+  in flight preserve submission order;
+- handle-scoped failures carry the retryable taxonomy and the elastic
+  rebuild ladder recovers (including teardown racing a pending handle);
+- bucketed-overlap sync is bitwise the fused single-allreduce sync at
+  world 2 AND 4 for bucket splits {1, several, odd} (exact-in-f32
+  inputs, so parity is about routing, not rounding);
+- the schedule digest is byte-identical to the fused path's at the
+  default bucket size (steady-state caches survive the upgrade), and
+  grows ``wire=bf16`` / a different ``schunk=`` only when those
+  actually change the plan;
+- the compressed path stays within tolerance, error feedback provably
+  bounds drift across 20 steps, and a corrupt rider on a compressed
+  frame NAKs/retransmits and heals bitwise (compressed frames are
+  ordinary sealed payloads);
+- the overlap trainer trains in lockstep with the fused trainer.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+from rocnrdma_tpu.collectives.world import RingWorld, local_worlds
+from rocnrdma_tpu.transport.engine import (TransportError,
+                                           fault_plan_reset,
+                                           seal_counters,
+                                           seal_counters_reset)
+
+from test_transport import free_port
+
+
+def _exact_inputs(world, count, seed=7):
+    """Integer-valued f32: every value and partial sum is exactly
+    representable, so bitwise parity across segmentations is about the
+    transport and routing, never summation-order rounding."""
+    rng = np.random.default_rng(seed)
+    return [rng.integers(-64, 64, size=count).astype(np.float32) * (r + 1)
+            for r in range(world)]
+
+
+_LEAF_SIZES = (4096, 1000, 33000, 77, 8192)
+
+
+def _exact_tree(rank, seed=11):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(-64, 64, size=n).astype(np.float32) * (rank + 1)
+            for n in _LEAF_SIZES]
+
+
+def _run_shims(worlds, shim_kw, trees):
+    outs = [None] * len(worlds)
+    errs = [None] * len(worlds)
+    shims = [CrossSliceAllReduce(w, mean=True, **shim_kw) for w in worlds]
+
+    def go(r):
+        try:
+            outs[r] = shims[r](trees[r])
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs[r] = e
+
+    ts = [threading.Thread(target=go, args=(r,))
+          for r in range(len(worlds))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for s in shims:
+        s.close()
+    for e in errs:
+        if e is not None:
+            raise e
+    return outs
+
+
+def _sync_pair(world_n, shim_kw, seed=11):
+    worlds = local_worlds(world_n, free_port())
+    try:
+        trees = [_exact_tree(r, seed) for r in range(world_n)]
+        return _run_shims(worlds, shim_kw, trees)
+    finally:
+        for w in worlds:
+            w.close()
+
+
+# ------------------------------------------------------- async handles
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_async_handles_bitwise_and_in_order(world):
+    """Several async allreduces in flight per rank complete with
+    results bitwise-identical to back-to-back blocking calls (ops
+    execute in submission order on the ring's driver), and the
+    handle-leak census returns to zero."""
+    count = (512 << 10) // 4
+    worlds = local_worlds(world, free_port())
+    try:
+        bufs = [[_exact_inputs(world, count, seed=k)[r] for k in range(3)]
+                for r in range(world)]
+        expect = [sum(_exact_inputs(world, count, seed=k),
+                      np.zeros(count, dtype=np.float32))
+                  for k in range(3)]
+
+        def run(r):
+            hs = [worlds[r].allreduce_async(b) for b in bufs[r]]
+            assert worlds[r].pending_async == len(hs)
+            for h in hs:
+                h.wait()
+            assert worlds[r].pending_async == 0
+
+        ts = [threading.Thread(target=run, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for r in range(world):
+            for k in range(3):
+                assert bufs[r][k].tobytes() == expect[k].tobytes(), \
+                    (r, k)
+    finally:
+        for w in worlds:
+            w.close()
+
+
+def test_async_failure_retryable_then_rebuild(monkeypatch):
+    """A transport failure inside an async collective surfaces from
+    the HANDLE as a retryable TransportError (handle-scoped failure:
+    the driver thread's error is bridged onto the handle), and the
+    existing rebuild ladder recovers — the next async allreduce on the
+    rebuilt world is bitwise correct."""
+    count = (64 << 10) // 4
+    worlds = local_worlds(2, free_port())
+    try:
+        monkeypatch.setenv("TDR_FAULT_PLAN", "ring:always=general_err")
+        fault_plan_reset()
+        errs = [None, None]
+
+        def fail(r):
+            try:
+                worlds[r].allreduce_async(
+                    _exact_inputs(2, count)[r]).wait()
+            except TransportError as e:
+                errs[r] = e
+
+        ts = [threading.Thread(target=fail, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(e is not None for e in errs), "fault never surfaced"
+        assert all(e.retryable for e in errs), errs
+        assert all(w.pending_async == 0 for w in worlds)
+
+        monkeypatch.delenv("TDR_FAULT_PLAN")
+        fault_plan_reset()
+        ts = [threading.Thread(
+            target=lambda r=r: worlds[r].rebuild(
+                max_attempts=8, backoff_s=0.05, timeout_ms=10000))
+            for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        bufs = _exact_inputs(2, count)
+        expect = sum(_exact_inputs(2, count),
+                     np.zeros(count, dtype=np.float32))
+
+        def ok(r):
+            worlds[r].allreduce_async(bufs[r]).wait()
+
+        ts = [threading.Thread(target=ok, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for b in bufs:
+            assert b.tobytes() == expect.tobytes()
+    finally:
+        monkeypatch.delenv("TDR_FAULT_PLAN", raising=False)
+        fault_plan_reset()
+        for w in worlds:
+            w.close()
+
+
+def test_teardown_with_pending_handle_fails_retryable():
+    """close() racing a pending handle never wedges: ring destroy
+    fails queued async ops promptly with a retryable error (a waiting
+    thread always wakes), and the pending census settles to zero."""
+    worlds = local_worlds(2, free_port())
+    count = (256 << 10) // 4
+    bufs = _exact_inputs(2, count)
+    handles = [None, None]
+
+    def submit_and_close(r):
+        # Three ops queued; the world closes underneath them. Each
+        # handle either completed (the race went that way) or fails
+        # RETRYABLE — never a hang, never a non-retryable class.
+        hs = [worlds[r].allreduce_async(bufs[r]) for _ in range(3)]
+        handles[r] = hs
+        worlds[r].close()
+
+    ts = [threading.Thread(target=submit_and_close, args=(r,))
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for r in range(2):
+        for h in handles[r]:
+            try:
+                h.wait(timeout_ms=30000)
+            except TransportError as e:
+                assert e.retryable, e
+        assert worlds[r].pending_async == 0
+
+
+# --------------------------------------------------- bucketed overlap
+
+
+@pytest.mark.parametrize("world", [2, 4])
+@pytest.mark.parametrize("bucket_bytes,label",
+                         [(1 << 20, "one"), (48 << 10, "several"),
+                          (130172, "odd")])
+def test_bucketed_parity_bitwise_vs_fused(world, bucket_bytes, label):
+    """The bucketed-overlap sync is BITWISE the fused single-allreduce
+    sync on the same exact-in-f32 gradient tree, for bucket splits
+    {1, several, odd-sized} at world 2 and 4 (mean division by a
+    power-of-two world is exact). The split genuinely differs across
+    the parametrization — asserted against the shared segment plan."""
+    sizes = list(_LEAF_SIZES)
+    plan = CrossSliceAllReduce._segment_plan(
+        list(range(len(sizes))), sizes, max(1, bucket_bytes // 4))
+    if label == "one":
+        assert len(plan) == 1, plan
+    else:
+        assert len(plan) > 1, plan
+
+    fused = _sync_pair(world, {})
+    bucketed = _sync_pair(world, {"overlap": True,
+                                  "bucket_bytes": bucket_bytes})
+    for r in range(world):
+        for a, b in zip(fused[r], bucketed[r]):
+            assert a.tobytes() == b.tobytes(), (world, label)
+
+
+def test_overlap_digest_matches_fused_at_default(monkeypatch):
+    """Acceptance pin: at the DEFAULT bucket size with no compression,
+    the overlap path's schedule describe string — and therefore its
+    digest — is byte-identical to the fused path's (same plan, same
+    terms; steady-state digest caches stay warm across the upgrade).
+    An explicit bucket size moves the ``schunk=`` term; bf16 wire
+    appends ``wire=bf16``; both are therefore rank-divergence-fatal
+    exactly like every other schedule knob."""
+    captured = {}
+    orig = RingWorld.check_schedule
+
+    def spy(self, digest, describe=""):
+        captured.setdefault(self._spy_tag, []).append((digest, describe))
+        return orig(self, digest, describe)
+
+    monkeypatch.setattr(RingWorld, "check_schedule", spy)
+
+    def run(tag, **kw):
+        worlds = local_worlds(2, free_port())
+        for w in worlds:
+            w._spy_tag = tag
+        try:
+            _run_shims(worlds, kw,
+                       [_exact_tree(r) for r in range(2)])
+        finally:
+            for w in worlds:
+                w.close()
+
+    run("fused")
+    run("overlap", overlap=True)
+    run("bucketed", overlap=True, bucket_bytes=32 << 10)
+    run("wire", overlap=True, wire_dtype="bf16")
+    fused = captured["fused"][0]
+    overlap = captured["overlap"][0]
+    assert overlap[1] == fused[1], (overlap[1], fused[1])
+    assert overlap[0] == fused[0]
+    assert "schunk=32768" in captured["bucketed"][0][1]
+    assert captured["bucketed"][0][0] != fused[0]
+    assert "wire=bf16" in captured["wire"][0][1]
+    assert captured["wire"][0][0] != fused[0]
+
+
+def test_wire_bf16_requires_overlap_and_validates():
+    worlds = local_worlds(2, free_port())
+    try:
+        with pytest.raises(ValueError, match="overlap"):
+            CrossSliceAllReduce(worlds[0], wire_dtype="bf16")
+        with pytest.raises(ValueError, match="bf16"):
+            CrossSliceAllReduce(worlds[0], overlap=True,
+                                wire_dtype="fp8")
+    finally:
+        for w in worlds:
+            w.close()
+
+
+def test_bucketed_staging_growth_reregisters_cleanly():
+    """A larger tree after a smaller one grows the staging buffer:
+    every front-loaded bucket-slice MR (bucket 0's slice shares the
+    base VA) must be dropped exactly once and re-registered — growth
+    mid-session neither raises nor corrupts results."""
+    worlds = local_worlds(2, free_port())
+    shims = [CrossSliceAllReduce(w, mean=True, overlap=True,
+                                 bucket_bytes=16 << 10)
+             for w in worlds]
+    try:
+        for count, seed in ((8192, 1), (65536, 2), (65536, 3)):
+            trees = [[_exact_inputs(2, count, seed)[r]] for r in range(2)]
+            expect = sum(_exact_inputs(2, count, seed),
+                         np.zeros(count, dtype=np.float32)) / 2
+            outs = [None, None]
+
+            def go(r):
+                outs[r] = shims[r](trees[r])
+
+            ts = [threading.Thread(target=go, args=(r,))
+                  for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            for r in range(2):
+                assert outs[r][0].tobytes() == expect.tobytes(), \
+                    (count, seed, r)
+    finally:
+        for s in shims:
+            s.close()
+        for w in worlds:
+            w.close()
+
+
+# ------------------------------------------------- bf16 wire + seal
+
+
+def test_wire_bf16_tolerance_and_error_feedback_bounds_drift():
+    """20 synthetic training steps with bf16 on-wire compression.
+
+    The gradient (1 + 2**-12) rounds DOWN to 1.0 in bf16 every time (8
+    mantissa bits): without error feedback the per-step rounding error
+    is systematic and the parameter drift vs the uncompressed run
+    grows linearly; WITH error feedback the residual accumulates until
+    it crosses a bf16 ulp and the wire value corrects, bounding the
+    drift. Asserts the EF run drifts strictly less than the no-EF run
+    AND stays within a small absolute bound."""
+    steps, lr, n = 20, 0.5, 2048
+    grad_val = np.float32(1.0) + np.float32(2.0 ** -12)
+
+    def train(world_n, wire, keep_ef):
+        worlds = local_worlds(world_n, free_port())
+        kw = ({"overlap": True, "bucket_bytes": 4096,
+               "wire_dtype": wire} if wire else {})
+        shims = [CrossSliceAllReduce(w, mean=True, **kw) for w in worlds]
+        params = [np.zeros(n, dtype=np.float32) for _ in range(world_n)]
+        try:
+            for _ in range(steps):
+                def step(r):
+                    g = np.full(n, grad_val, dtype=np.float32)
+                    (mean_g,) = shims[r]([g])
+                    params[r] -= lr * mean_g
+                ts = [threading.Thread(target=step, args=(r,))
+                      for r in range(world_n)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if not keep_ef:
+                    for s in shims:
+                        for res in s._residuals.values():
+                            res[:] = 0.0
+        finally:
+            for s in shims:
+                s.close()
+            for w in worlds:
+                w.close()
+        return params[0]
+
+    exact = train(2, None, True)
+    with_ef = train(2, "bf16", True)
+    without_ef = train(2, "bf16", False)
+    drift_ef = float(np.max(np.abs(with_ef - exact)))
+    drift_no = float(np.max(np.abs(without_ef - exact)))
+    # No-EF: 20 steps * lr * 2^-12 systematic loss ≈ 2.44e-3.
+    assert drift_no > 1e-3, drift_no
+    assert drift_ef < drift_no, (drift_ef, drift_no)
+    # EF bounds the drift to ~a couple of bf16 ulps of the running sum.
+    assert drift_ef < 1e-3, drift_ef
+
+
+def test_corrupt_rider_on_compressed_frame_naks_and_heals(monkeypatch):
+    """Compressed frames are ordinary sealed payloads: a deterministic
+    send-site corruption on a bf16 bucket under full CMA sealing fails
+    verification, NAKs, retransmits clean, and the compressed result
+    is BITWISE the uncorrupted compressed run (bf16 rounding is
+    deterministic, so heal-exactness is checkable)."""
+    monkeypatch.setenv("TDR_SEAL_CMA", "1")  # payload CRC on CMA
+    monkeypatch.setenv("TDR_RING_CHUNK", str(16 << 10))
+    kw = {"overlap": True, "bucket_bytes": 32 << 10,
+          "wire_dtype": "bf16"}
+
+    def run():
+        worlds = local_worlds(2, free_port())
+        try:
+            # Non-integer values so compression genuinely rounds.
+            trees = [[(np.arange(16384, dtype=np.float32) % 977)
+                      * np.float32(1.0009) * (r + 1)]
+                     for r in range(2)]
+            return _run_shims(worlds, kw, trees)
+        finally:
+            for w in worlds:
+                w.close()
+
+    clean = run()
+    monkeypatch.setenv("TDR_FAULT_PLAN", "send:chunk=0:nth=1:corrupt=3")
+    fault_plan_reset()
+    seal_counters_reset()
+    try:
+        healed = run()
+        c = seal_counters()
+        assert c["failed"] >= 1 and c["retransmitted"] >= 1, c
+        for r in range(2):
+            for a, b in zip(clean[r], healed[r]):
+                assert a.tobytes() == b.tobytes()
+    finally:
+        monkeypatch.delenv("TDR_FAULT_PLAN", raising=False)
+        fault_plan_reset()
+        seal_counters_reset()
+
+
+# --------------------------------------------------- trainer overlap
+
+
+def test_trainer_overlap_trains_in_lockstep_with_fused():
+    """The config-4 story with the backward-overlap sync: two 'slices'
+    training llama-tiny with CrossSliceAllReduce(overlap=True) produce
+    the same loss trajectory as the fused-sync pair, the slices stay
+    in lockstep with each other, and the async handle path demonstrably
+    carried the gradients (world.allreduce_async counted, all handles
+    settled)."""
+    from rocnrdma_tpu.parallel.trainer import Trainer
+    from rocnrdma_tpu.utils.trace import trace
+
+    rng = np.random.default_rng(4)
+    batches = [rng.integers(0, 255, (2, 17)).astype(np.int32)
+               for _ in range(2)]
+
+    def run_pair(overlap):
+        worlds = local_worlds(2, free_port())
+        shims = [CrossSliceAllReduce(w, mean=True, overlap=overlap,
+                                     bucket_bytes=(64 << 10) if overlap
+                                     else None)
+                 for w in worlds]
+        trainers = [Trainer("llama-tiny", {"dp": 1, "tp": 1}, seed=5,
+                            cross_slice_sync=shims[r])
+                    for r in range(2)]
+        losses = [[], []]
+
+        def run_slice(r):
+            for step in range(2):
+                losses[r].append(trainers[r].step(batches[r]))
+
+        ts = [threading.Thread(target=run_slice, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        params = [trainers[r].params for r in range(2)]
+        pend = [w.pending_async for w in worlds]
+        for s in shims:
+            s.close()
+        for w in worlds:
+            w.close()
+        assert pend == [0, 0], "leaked async handles"
+        return losses, params
+
+    before = trace.counter("world.allreduce_async")
+    o_losses, o_params = run_pair(True)
+    assert trace.counter("world.allreduce_async") > before, \
+        "overlap path never launched an async collective"
+    f_losses, f_params = run_pair(False)
+    for a, b in zip(o_losses[0] + o_losses[1],
+                    f_losses[0] + f_losses[1]):
+        assert abs(a - b) < 5e-4, (o_losses, f_losses)
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(o_params[0]),
+                    jax.tree_util.tree_leaves(o_params[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
