@@ -62,6 +62,35 @@ def _free_port():
     return port
 
 
+def _port_band(span, lo=21000, hi=29000):
+    """Bind-probe a CONTIGUOUS free port band below the ephemeral
+    range — for the hierarchical world, whose tier rings listen
+    across base..base+~world*4 and bind only at the first hier call
+    (an ephemeral _free_port base invites a kernel-assigned client
+    port to squat the span mid-bench and wedge a digest hop for the
+    full stall deadline; the repo's port-band convention)."""
+    import random
+    import socket
+
+    rng = random.Random()
+    for _ in range(128):
+        base = rng.randrange(lo, hi - span)
+        socks = []
+        try:
+            for p in range(base, base + span):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", p))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no free {span}-port band in [{lo}, {hi})")
+
+
 def bench_roofline(nbytes=256 << 20, iters=5):
     """Single-core memcpy and f32 fold (a += b) GB/s — the memory
     system's answer to 'how fast could ANY allreduce go here'."""
@@ -262,6 +291,148 @@ def windowed_fold_main(count, iters):
     }))
 
 
+def bench_hier_crossover(quick):
+    """World-8 two-host-emulated hierarchical vs flat allreduce — the
+    r09 tentpole's headline. TDR_TOPOLOGY=a,a,a,a,b,b,b,b partitions
+    the in-process world into two 4-rank "hosts"; per message size the
+    same buffers run the flat wavefront ring and the two-tier schedule
+    (intra reduce-scatter → stream-tier delegate-ring allreduce →
+    intra all-gather), bus-bandwidth convention for both so the ratio
+    is apples-to-apples. The crossover table is the machine-truth the
+    size-aware algorithm switch (TDR_ALGO=auto, TDR_HIER_MIN_BYTES)
+    approximates without a sweep.
+
+    Gate honesty (the BENCH_r08 convention): hier >= flat at the
+    largest size is gated ONLY on >= 2-core hosts. On one core the
+    comparison is rigged by arithmetic, not implementation: every fold
+    and copy of BOTH tiers shares the single core and hier adds a full
+    intra-host RS+AG pass of memory traffic the flat ring does not
+    pay, so flat >= hier by construction there — the record carries
+    the bound note and flips to a measured gate when CI regains
+    cores."""
+    import threading as _t
+
+    from rocnrdma_tpu.collectives.topology import hier_min_bytes
+    from rocnrdma_tpu.collectives.world import local_worlds
+
+    world = 8
+    sizes = ([64 << 10, 512 << 10] if quick
+             else [256 << 10, 1 << 20, 4 << 20, 16 << 20, 64 << 20])
+    iters = 1 if quick else 2
+    # Explicit topology= (not the process env): a transient rebuild
+    # mid-bench re-resolves topology per incarnation, and a restored-
+    # away env would silently degrade the remaining 'hier' rows to
+    # the flat ring — writing ratio≈1.0 into the record as machine
+    # truth. The port band covers the tier arenas, which bind only at
+    # the first hier collective.
+    worlds = local_worlds(world, _port_band(world * 4 + 8),
+                          channels="auto",
+                          topology=["a"] * 4 + ["b"] * 4)
+    out = {"world": world, "topology": "2 hosts x 4 ranks (emulated)",
+           "channels": worlds[0].channels,
+           "tier_channels": worlds[0]._tier_channels(),
+           "hier_min_bytes": hier_min_bytes(), "iters": iters}
+    rows = []
+    try:
+        for nbytes in sizes:
+            count = nbytes // 4
+            bufs = [np.ones(count, dtype=np.float32)
+                    for _ in range(world)]
+            for w, b in zip(worlds, bufs):
+                w.ring.register_buffer(b)
+            row = {"bytes": nbytes}
+            for algo in ("flat", "hier"):
+                def run_all():
+                    ts = [_t.Thread(target=worlds[r].allreduce,
+                                    args=(bufs[r],),
+                                    kwargs={"algo": algo})
+                          for r in range(world)]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+
+                run_all()  # warmup (tier bring-up, per-call tier MRs)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    run_all()
+                dt = (time.perf_counter() - t0) / iters
+                row[f"{algo}_GBps"] = round(
+                    nbytes * 2 * (world - 1) / world / dt / 1e9, 3)
+            row["ratio"] = round(row["hier_GBps"] / row["flat_GBps"], 3)
+            row["winner"] = ("hier" if row["hier_GBps"]
+                             >= row["flat_GBps"] else "flat")
+            rows.append(row)
+            for w, b in zip(worlds, bufs):
+                w.ring.unregister_buffer(b)
+    finally:
+        for w in worlds:
+            try:
+                w.close()
+            except Exception:
+                pass
+    out["rows"] = rows
+    winners = [r["bytes"] for r in rows if r["winner"] == "hier"]
+    out["crossover_bytes"] = min(winners) if winners else None
+    largest = rows[-1]
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    met = largest["winner"] == "hier"
+    bound_note = None
+    if not met and cores < 2:
+        bound_note = (
+            "1-core host: every fold/copy of both tiers shares the "
+            "single core and hier adds a full intra-host RS+AG pass "
+            "the flat ring does not pay, so flat >= hier by "
+            "arithmetic — gate measured only with >= 2 usable cores "
+            "(BENCH_r08 cores-aware convention; re-scored "
+            "automatically when CI regains cores)")
+    out["largest"] = {
+        "at_bytes": largest["bytes"],
+        "flat_GBps": largest["flat_GBps"],
+        "hier_GBps": largest["hier_GBps"],
+        "ratio": largest["ratio"],
+        "host_cores": cores,
+        "met": met,
+        "bound_note": bound_note,
+    }
+    return out
+
+
+def bench_channels_auto_by_world(sweep_ch, quick):
+    """channels_auto per WORLD SIZE: the best-measured channel count
+    with a per-world monotone flag (BENCH_r09 satellite — the w4 sweep
+    alone hid that the knee moves with rank count). World 4 reuses the
+    full sweep; world 2 runs a small dedicated {1,2,4} sweep; world 8
+    records the heuristic resolve (its measured point is the hier
+    bench, which runs channels='auto')."""
+    from rocnrdma_tpu.collectives.world import auto_channel_cap
+
+    w2_count = ((1 << 20) // 4) if quick else ((64 << 20) // 4)
+    per = {}
+    for ch in (1, 2, 4):
+        bw = bench_allreduce(count=w2_count, world=2, iters=1,
+                             channels=ch)
+        per[str(ch)] = round(bw, 3)
+    bws = [per[str(c)] for c in (1, 2, 4)]
+    best2 = max(per.items(), key=lambda kv: kv[1])
+    return {
+        "2": {"channels_auto": int(best2[0]),
+              "by_channels": per,
+              "monotone": all(b >= a * 0.95
+                              for a, b in zip(bws, bws[1:])),
+              "heuristic_cap": auto_channel_cap(["127.0.0.1"] * 2, 0)},
+        "4": {"channels_auto": sweep_ch.get("channels_auto"),
+              "monotone": sweep_ch.get("monotone"),
+              "heuristic_cap": sweep_ch.get("channels_heuristic_cap")},
+        "8": {"heuristic_cap": auto_channel_cap(["127.0.0.1"] * 8, 0),
+              "note": "measured point rides the hier bench "
+                      "(channels='auto', tier budget split)"},
+    }
+
+
 def bench_trainer_overlap(quick, timeout_s=900):
     """Backward-overlap trainer sub-bench: the world-2 bucketed train
     loop (tools/overlap_smoke.py) in a SUBPROCESS — the smoke forces
@@ -429,7 +600,7 @@ def write_bench_record(details, bus, tel, quick, details_path):
     never clobber the repo's official trajectory point."""
     from rocnrdma_tpu.collectives.staging import staging
 
-    rnd = os.environ.get("TDR_BENCH_ROUND", "r08")
+    rnd = os.environ.get("TDR_BENCH_ROUND", "r09")
     # Saturation check (the r06 defect this round fixes): percentiles
     # that all sit on one octave edge carry no information — with the
     # fine (log2 × 8) histograms that only happens when the recording
@@ -528,6 +699,21 @@ def write_bench_record(details, bus, tel, quick, details_path):
         "train_step_overlap_fraction": details.get(
             "trainer_overlap", {}).get("overlap_fraction"),
         "train_step": details.get("trainer_overlap"),
+        # Hierarchical topology-aware allreduce (the r09 tentpole):
+        # world-8 two-host-emulated flat vs hier bus bandwidth at the
+        # largest benched message (cores-aware gate — met, or the
+        # bound note documenting why a 1-core host cannot meet it)
+        # plus the full message-size crossover table the TDR_ALGO=auto
+        # switch approximates.
+        "allreduce_world8_hier_vs_flat": details.get(
+            "hier", {}).get("largest"),
+        "hier_crossover": details.get("hier", {}).get("rows"),
+        "hier_crossover_bytes": details.get(
+            "hier", {}).get("crossover_bytes"),
+        "hier_min_bytes": details.get("hier", {}).get("hier_min_bytes"),
+        # Best-measured channel count + monotone flag PER WORLD SIZE
+        # (the w4-only sweep hid that the knee moves with rank count).
+        "channels_auto_by_world": details.get("channels_auto_by_world"),
     }
     path = os.environ.get("TDR_BENCH_RECORD")
     if not path:
@@ -909,6 +1095,11 @@ def main():
             "met": bool(gate_value is not None
                         and gate_value >= 0.85),
         }
+    # Hierarchical vs flat at world 8 (two emulated hosts) + the
+    # per-world-size channels_auto record (r09 tentpole + satellite).
+    details["hier"] = bench_hier_crossover(quick)
+    details["channels_auto_by_world"] = bench_channels_auto_by_world(
+        sweep_ch, quick)
     details.update(bench_staged(nbytes=sizes["staged_nbytes"]))
     details["sweep_write"] = bench_sweep(max_size=sizes["sweep_max"])
     # Flight-recorder sub-bench LAST among the transport benches: it
@@ -961,6 +1152,8 @@ def main():
         "staged_serial_GBps": details.get("staged_serial_GBps"),
         "train_step_overlap_fraction": details.get(
             "trainer_overlap", {}).get("overlap_fraction"),
+        "hier_vs_flat_world8": details.get(
+            "hier", {}).get("largest", {}).get("ratio"),
         "tpu": tpu[:160],
         "details_file": details_file,
         "bench_record": os.path.basename(record_path),

@@ -414,6 +414,15 @@ class CrossSliceAllReduce:
         ctl_stamp = getattr(self.world, "control_stamp", "")
         if ctl_stamp:
             sched.append(ctl_stamp)
+        # Hierarchical topology + algorithm selector (ROADMAP item 1):
+        # the topology map (shape + host-key fingerprint) and the
+        # TDR_ALGO mode/threshold are schedule-selecting — a rank
+        # grouping the world differently, or switching flat→hier at a
+        # different size, posts onto different rings. Flat worlds
+        # contribute NOTHING, so legacy digests stay byte-identical.
+        topo_stamp = getattr(self.world, "topology_stamp", "")
+        if topo_stamp:
+            sched.append(topo_stamp)
         # Recv-reduce gating is schedule-selecting too (fused
         # reduce-on-receive vs the windowed-scratch schedule), and it
         # is a PER-PROCESS env knob (TDR_NO_RECV_REDUCE), never

@@ -42,6 +42,18 @@ more than one world run with the engine-wide seal incarnation stamp
 cleared — co-tenant worlds at different generations would fence each
 other's frames — so stale-world protection there degrades to the
 schedule-digest generation check, which is per world.
+
+**Hierarchical topologies.** A world whose host-key topology map
+(``topology=`` / TDR_TOPOLOGY / coordinator-view ``host_keys``)
+partitions the ranks into >= 2 uniform intra-host groups can run its
+allreduces on the two-tier schedule: intra-host reduce-scatter →
+inter-host delegate-ring allreduce over the owned shard → intra-host
+all-gather, chosen per call by a message-size-aware selector
+(``TDR_ALGO``, ``TDR_HIER_MIN_BYTES``; collectives/topology.py). Tier
+rings are ordinary RingWorlds built lazily per incarnation — the
+inter-host ring pinned to the stream tier so it keeps full payload
+seals — and they die and rebuild with the parent's generation, so the
+elastic ladder holds per tier. See README "Hierarchical collectives".
 """
 
 from __future__ import annotations
@@ -55,6 +67,9 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from rocnrdma_tpu.collectives.topology import (TopologyMap, algo_stamp,
+                                               choose_algo,
+                                               resolve_topology)
 from rocnrdma_tpu.transport.engine import (Engine, QueuePair, Ring, RED_SUM,
                                            RingOp, TransportError,
                                            note_fault_injections,
@@ -76,10 +91,12 @@ class CollectiveHandle:
     data buffer alive until completion; completion accounting feeds
     ``RingWorld.pending_async`` (the handle-leak census)."""
 
-    def __init__(self, world: "RingWorld", op: RingOp, nbytes: int):
+    def __init__(self, world: "RingWorld", op: RingOp, nbytes: int,
+                 what: str = "allreduce"):
         self._world = world
         self._op = op
         self._nbytes = nbytes
+        self._what = what
         self._t0 = time.monotonic()
         self._settled = False
 
@@ -103,8 +120,8 @@ class CollectiveHandle:
             raise
         if ok:
             self._settle()
-            trace.event("world.allreduce_done", rank=self._world.rank,
-                        bytes=self._nbytes,
+            trace.event(f"world.{self._what}_done",
+                        rank=self._world.rank, bytes=self._nbytes,
                         dur_s=time.monotonic() - self._t0)
         return ok
 
@@ -122,9 +139,137 @@ class CollectiveHandle:
             self._settle()
             raise
         self._settle()
-        trace.event("world.allreduce_done", rank=self._world.rank,
+        trace.event(f"world.{self._what}_done", rank=self._world.rank,
                     bytes=self._nbytes,
                     dur_s=time.monotonic() - self._t0)
+
+class _PhasedHandle:
+    """Handle for a chained multi-phase async collective — the
+    hierarchical allreduce (intra reduce-scatter → delegate-ring
+    allreduce → intra all-gather) or the staged two-phase flat
+    composition (RS → AG). Same surface and failure semantics as
+    :class:`CollectiveHandle`.
+
+    **Ordering.** Phase 0 is submitted at creation, so creation order
+    across handles IS phase-0 submission order. Later phases submit
+    only after (a) the handle's own previous phase completed and (b)
+    every EARLIER handle's chain fully submitted — enforced by driving
+    the predecessor chain first — so each underlying ring sees phase
+    submissions in creation order on every rank, whatever order the
+    caller polls handles in. That per-ring determinism is the SPMD
+    submission-order contract the native async driver requires.
+
+    Failures are recorded and raised to THIS handle's waiter exactly
+    once (driving a predecessor on behalf of a later handle never
+    steals its error)."""
+
+    def __init__(self, world: "RingWorld", array, op: int, hier: bool):
+        self._world = world
+        self._array = array
+        self._op = op
+        self._nbytes = int(array.nbytes)
+        self._what = "hier_allreduce" if hier else "staged_allreduce"
+        self._t0 = time.monotonic()
+        self._settled = False
+        self._err: Optional[TransportError] = None
+        self._raised = False
+        flat = array.reshape(-1)
+        if hier:
+            intra, inter = world._ensure_tiers()
+            shard = flat[intra.owned_slice(flat)]
+            self._pending = [
+                lambda: intra.reduce_scatter_async(flat, op),
+                lambda: inter.allreduce_async(shard, op, algo="flat"),
+                lambda: intra.all_gather_async(flat),
+            ]
+        else:
+            self._pending = [
+                lambda: world.reduce_scatter_async(flat, op),
+                lambda: world.all_gather_async(flat),
+            ]
+        # Phase 0 submits NOW — creation order is submission order.
+        # Submission happens BEFORE this handle registers in the chain
+        # tail / census: a phase-0 failure (ring torn down between
+        # ops) must abort construction cleanly — the caller gets the
+        # retryable TransportError from allreduce_async itself — and
+        # must not leave a half-built handle linked as a later
+        # handle's predecessor or counted as pending forever.
+        self._cur = self._pending.pop(0)()
+        self._prev = world._phased_tail
+        if self._prev is not None and self._prev._settled:
+            self._prev = None
+        world._phased_tail = self
+        world._async_live += 1
+        trace.add("algo.hier" if hier else "algo.staged", 1)
+        trace.event(f"world.{self._what}_async", rank=world.rank,
+                    bytes=self._nbytes)
+
+    @property
+    def done(self) -> bool:
+        return self._settled
+
+    def _finish(self, err: Optional[TransportError]) -> None:
+        self._err = err
+        self._settled = True
+        self._world._async_live -= 1
+        if self._world._phased_tail is self:
+            self._world._phased_tail = None
+        self._prev = None
+        self._array = None
+        if err is None:
+            trace.event(f"world.{self._what}_done",
+                        rank=self._world.rank, bytes=self._nbytes,
+                        dur_s=time.monotonic() - self._t0)
+
+    def _drive(self, blocking: bool) -> bool:
+        """Advance the chain; True when terminal (ok or failed).
+        Never raises — errors are recorded for _raise_once, so a later
+        handle driving this one as its predecessor cannot consume the
+        error its own waiter must see."""
+        if self._settled:
+            return True
+        if self._prev is not None:
+            if not self._prev._drive(blocking):
+                return False
+            self._prev = None
+        try:
+            while True:
+                if blocking:
+                    self._cur.wait()
+                elif not self._cur.test():
+                    return False
+                if not self._pending:
+                    self._finish(None)
+                    return True
+                self._cur = self._pending.pop(0)()
+        except TransportError as e:
+            self._finish(e)
+            return True
+
+    def _raise_once(self) -> None:
+        if self._err is not None and not self._raised:
+            self._raised = True
+            raise self._err
+
+    def test(self) -> bool:
+        """True once the whole chain completed OK; raises on failure
+        (once). Advances this handle's phases — and any predecessor
+        chain — nonblocking."""
+        if not self._drive(blocking=False):
+            return False
+        self._raise_once()
+        return True
+
+    def wait(self, timeout_ms: int = -1) -> None:
+        """Block until the chain completes; raises the first phase's
+        TransportError on failure. Phase chains always run to a
+        terminal state (each phase is bounded by the ring stall
+        deadline); a positive ``timeout_ms`` is accepted for interface
+        parity but the wait is to completion."""
+        del timeout_ms
+        self._drive(blocking=True)
+        self._raise_once()
+
 
 # wr_id tags for the schedule-digest exchange — distinct from the
 # ring's kWrRecv/kWrSend tag space (0x5245/0x5345 << 48).
@@ -153,7 +298,7 @@ def rebuild_jitter_seed() -> int:
 
 
 def auto_channel_cap(peers: Optional[Sequence[str]] = None,
-                     rank: int = 0) -> int:
+                     rank: int = 0, rings: int = 1) -> int:
     """Per-host channel cap applied by ``RingWorld(channels="auto")``:
     the TDR_RING_CHANNELS default capped at usable-cores-per-local-rank
     — the PR 4 saturation note made executable. On an in-process or
@@ -164,7 +309,13 @@ def auto_channel_cap(peers: Optional[Sequence[str]] = None,
     sharing this rank's host entry; an ABSENT peer list carries no
     locality information, so only the core count caps (RingWorld
     always passes its resolved peer list, where a defaulted world is
-    all-loopback and every rank counts as local)."""
+    all-loopback and every rank counts as local).
+
+    ``rings`` divides the budget across CONCURRENTLY LIVE rings: a
+    hierarchical world pipelines its intra-host and inter-host
+    delegate rings, so each tier gets cores/(local*rings) — two rings
+    each independently claiming the full core budget would double the
+    progress-thread pressure the cap exists to avoid."""
     try:
         cores = len(os.sched_getaffinity(0))
     except (AttributeError, OSError):
@@ -176,7 +327,8 @@ def auto_channel_cap(peers: Optional[Sequence[str]] = None,
         local = 1
     from rocnrdma_tpu.transport.engine import ring_channels_default
 
-    return max(1, min(ring_channels_default(), max(1, cores // local)))
+    denom = local * max(1, int(rings))
+    return max(1, min(ring_channels_default(), max(1, cores // denom)))
 
 
 class RingWorld:
@@ -194,6 +346,8 @@ class RingWorld:
         controller=None,
         world_name: str = "default",
         qp_budget: Optional[int] = None,
+        topology=None,  # host-key list, None (env/view), or "flat"
+        tier: str = "auto",  # "stream" pins connections off the CMA tier
     ):
         if world < 2:
             raise ValueError("RingWorld needs world >= 2")
@@ -218,6 +372,7 @@ class RingWorld:
         # (auto_channel_cap) instead of blindly taking the env count;
         # the digest still carries the RESOLVED count, so ranks whose
         # auto answers diverge fail the first collective fast.
+        self._channels_auto = channels == "auto"
         if isinstance(channels, str):
             if channels != "auto":
                 raise ValueError(f"channels={channels!r}: expected an "
@@ -275,6 +430,31 @@ class RingWorld:
         self._sched_verified: bytes = b""
         # Outstanding async collective handles (pending_async).
         self._async_live = 0
+        # ---- Hierarchical topology (ROADMAP item 1) ----
+        # ``topology``: an explicit host-key list, None (resolve from
+        # TDR_TOPOLOGY, else the coordinator view's host_keys), or
+        # "flat" (disabled — what the tier sub-worlds themselves pass
+        # so tiers never recurse). ``tier="stream"`` pins every
+        # connection of THIS world off the CMA fast path (the
+        # emulated inter-host delegate ring keeps full payload seals).
+        if isinstance(topology, str) and topology != "flat":
+            raise ValueError(f"topology={topology!r}: expected a "
+                             "host-key list, None, or 'flat'")
+        self._topology_arg = topology
+        self._force_stream = tier == "stream"
+        if tier not in ("auto", "stream"):
+            raise ValueError(f"tier={tier!r}: expected 'auto' or "
+                             "'stream'")
+        self.topology: Optional[TopologyMap] = None
+        self._ctl_host_keys: Optional[List[str]] = None
+        # Tier sub-worlds (lazily built at the first hierarchical
+        # collective of each incarnation; torn down with it).
+        self._tier_intra: Optional["RingWorld"] = None
+        self._tier_inter: Optional["RingWorld"] = None
+        self._tier_gen: Optional[int] = None
+        # Tail of the phased-handle chain (per-ring submission-order
+        # determinism for async hier/staged collectives).
+        self._phased_tail = None
         try:
             self._bootstrap(timeout_ms)
         except BaseException:
@@ -300,7 +480,9 @@ class RingWorld:
         while True:
             left_ms = int(max((deadline - time.monotonic()) * 1000, 1))
             try:
-                return self.engine.listen(host, port, left_ms)
+                return self.engine.listen(
+                    host, port, left_ms,
+                    force_stream=getattr(self, "_force_stream", False))
             except TransportError as e:
                 if "address already in use" not in str(e).lower():
                     raise
@@ -311,7 +493,9 @@ class RingWorld:
     def _connect(self, host: str, port: int, timeout_ms: int) -> QueuePair:
         """Dial one neighbor (the native layer already retries until
         the listener is up, bounded by the deadline)."""
-        return self.engine.connect(host, port, timeout_ms)
+        return self.engine.connect(
+            host, port, timeout_ms,
+            force_stream=getattr(self, "_force_stream", False))
 
     def _bootstrap(self, timeout_ms: int) -> None:
         """Bring up neighbor QPs + ring and agree on the generation.
@@ -426,6 +610,19 @@ class RingWorld:
         except BaseException:
             self._teardown()
             raise
+        # Topology map for the hierarchical schedule: explicit param >
+        # TDR_TOPOLOGY > the coordinator view's host keys. Resolved
+        # per incarnation (an arbitrated rebuild may release different
+        # membership); tiers themselves pass topology="flat" and never
+        # recurse. A non-hierarchical map (one host, singleton groups,
+        # uneven groups) still resolves — the selector just never
+        # picks hier for it.
+        if self._topology_arg == "flat":
+            self.topology = None
+        else:
+            self.topology = resolve_topology(
+                self.world, self.rank, explicit=self._topology_arg,
+                view_keys=self._ctl_host_keys)
         if arbitrated:
             self._ensure_heartbeat()
         # tel_engine ties this rank to its native flight-recorder
@@ -474,8 +671,25 @@ class RingWorld:
                 host = (self.peers[self.rank]
                         if self.peers and 0 <= self.rank < len(self.peers)
                         else "127.0.0.1")
+                # Topology key for this member (explicit/env override
+                # first; the dial address otherwise): released to
+                # every slot in the view as host_keys.
+                key = None
+                keys = self._topology_arg
+                if keys is None or keys == "flat":
+                    from rocnrdma_tpu.collectives.topology import \
+                        parse_env_topology
+
+                    try:
+                        keys = parse_env_topology(self.world)
+                    except ValueError:
+                        keys = None
+                if keys and keys != "flat" and \
+                        0 <= self.rank < len(keys):
+                    key = str(keys[self.rank])
                 view = self.controller.join(self.world_name, self.world,
                                             rank=self.rank, host=host,
+                                            host_key=key,
                                             timeout_s=timeout_s)
                 if not view.get("ok"):
                     raise TransportError(
@@ -495,6 +709,14 @@ class RingWorld:
         peers = view.get("peers")
         if peers:
             self.peers = [str(p) for p in peers]
+        # Adopt the view's topology keys only when EVERY slot carries
+        # one: a partially-keyed membership must resolve flat, never
+        # guess (and dial addresses are deliberately not a fallback).
+        host_keys = view.get("host_keys")
+        self._ctl_host_keys = (
+            [str(k) for k in host_keys]
+            if host_keys and all(k is not None for k in host_keys)
+            else None)
         budget = int(view.get("qp_budget") or 0)
         if budget:
             # Coordinator-assigned per-world budget: the stricter of
@@ -536,6 +758,10 @@ class RingWorld:
             snap.update(trace.counters_prefixed("world."))
             snap.update(trace.counters_prefixed("ctl."))
             snap.update(trace.counters_prefixed("trainer."))
+            # Which algorithm carried the collectives (flat / hier /
+            # staged call counts — the selector made observable on
+            # /metrics as tdr_algo_*_total).
+            snap.update(trace.counters_prefixed("algo."))
             return snap
 
         def _hists():
@@ -603,13 +829,179 @@ class RingWorld:
                 "incarnation); rebuild() required", retryable=True)
         return ring
 
-    def allreduce(self, array, op: int = RED_SUM) -> None:
-        """In-place ring allreduce of a C-contiguous numpy array."""
+    # ------------------------------------------- hierarchical tiers
+    #
+    # A world with a hierarchical TopologyMap lazily brings up two
+    # tier sub-rings per incarnation: the intra-host ring (this rank's
+    # co-located group — CMA tier, tag-only seals) and the inter-host
+    # delegate ring (this rank's local index on every host — pinned to
+    # the stream tier so the emulated "slow" links keep full payload
+    # seals). The hierarchical allreduce then runs intra
+    # reduce-scatter → delegate-ring allreduce over the owned shard →
+    # intra all-gather; inter-host bytes shrink by the local group
+    # size. Tiers are ordinary RingWorlds (legacy pairwise path,
+    # topology="flat" so they never recurse) sharing this world's
+    # generation, so the elastic ladder holds per tier: any tier
+    # failure surfaces as a retryable TransportError, rebuild() tears
+    # every tier down with the incarnation, and the next hierarchical
+    # collective rebuilds them under the bumped generation.
+
+    def _tier_channels(self) -> int:
+        """Channel count for the tier sub-rings: with channels="auto"
+        the usable-cores budget divides across the two concurrently
+        live rings (intra + delegate) instead of each claiming the
+        full cap; explicit channel counts are inherited as-is."""
+        if self._channels_auto:
+            return auto_channel_cap(self.peers, self.rank, rings=2)
+        return self.channels
+
+    def _ensure_tiers(self):
+        """Bring up (or return) this incarnation's tier sub-rings.
+        Deterministic port layout inside the world's port arena:
+        intra group g listens on base + world*(1+g) + local_rank;
+        inter ring l (one per local index) on base + world*(1+hosts)
+        + l*hosts + host_index — disjoint from the flat ring's
+        base + rank and from each other. All ranks reach this from
+        the same (digest-agreed) collective, so the tier rendezvous
+        is concurrent by construction."""
+        topo = self.topology
+        if topo is None or not topo.hierarchical:
+            raise TransportError(
+                f"hierarchical collective on rank {self.rank} without "
+                "a hierarchical topology (set TDR_TOPOLOGY or pass "
+                "topology=)", retryable=False)
+        if self._tier_gen == self.generation and \
+                self._tier_intra is not None:
+            return self._tier_intra, self._tier_inter
+        self._close_tiers()
+        self._live_ring()  # torn down -> retryable, before bring-up
+        world, hosts = self.world, topo.n_hosts
+        nchan = self._tier_channels()
+        intra_base = self.base_port + world * (1 + topo.host_index)
+        intra = RingWorld(
+            self.engine, topo.local_rank, topo.local_size, intra_base,
+            peers=[self.peers[g] for g in topo.group],
+            bind_host=self.bind_host, timeout_ms=self.timeout_ms,
+            generation=self.generation, channels=nchan,
+            topology="flat", world_name=self.world_name + ".intra")
+        try:
+            inter_base = (self.base_port + world * (1 + hosts)
+                          + topo.local_rank * hosts)
+            inter = RingWorld(
+                self.engine, topo.host_index, hosts, inter_base,
+                peers=[self.peers[g] for g in topo.delegate_ring()],
+                bind_host=self.bind_host, timeout_ms=self.timeout_ms,
+                generation=self.generation, channels=nchan,
+                topology="flat", tier="stream",
+                world_name=self.world_name + f".x{topo.local_rank}")
+        except BaseException:
+            try:
+                intra.close()
+            except Exception:
+                pass
+            raise
+        self._tier_intra, self._tier_inter = intra, inter
+        self._tier_gen = self.generation
+        trace.event("world.tiers_up", rank=self.rank,
+                    hosts=hosts, local=topo.local_size,
+                    channels=nchan, generation=self.generation)
+        return intra, inter
+
+    def _close_tiers(self) -> None:
+        """Best-effort teardown of the tier sub-rings (never raises;
+        rides every _teardown so a rebuild always rebuilds BOTH tiers
+        under the new generation)."""
+        for w in (self._tier_intra, self._tier_inter):
+            if w is not None:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+        self._tier_intra = self._tier_inter = None
+        self._tier_gen = None
+
+    @property
+    def topology_stamp(self) -> str:
+        """Schedule-digest term for the hierarchical configuration:
+        the topology shape/fingerprint plus the algorithm-selector
+        mode. Empty for flat worlds (legacy digests byte-identical);
+        with it, two ranks grouping the world differently — or
+        switching algorithms at different sizes — fail the first
+        collective's digest exchange instead of desynchronizing."""
+        topo = self.topology
+        if topo is None or not topo.hierarchical:
+            return ""
+        return f"{topo.stamp()} {algo_stamp(topo)}"
+
+    def _algo_for(self, nbytes: int, algo: Optional[str]) -> str:
+        """Resolve the per-call algorithm (explicit override or the
+        size/topology selector), degrading hier to flat when the
+        topology cannot carry it or the message is smaller than the
+        world (empty segments)."""
+        if algo is None:
+            algo = choose_algo(int(nbytes), self.topology)
+        elif algo not in ("flat", "hier", "staged"):
+            raise ValueError(f"algo={algo!r}: expected 'flat', "
+                             "'hier', or 'staged'")
+        if algo == "hier":
+            topo = self.topology
+            if topo is None or not topo.hierarchical:
+                return "flat"
+            # Every intra segment and every inter segment must be
+            # non-empty: count >= world gives count/local >= hosts.
+            if int(nbytes) == 0 or \
+                    int(nbytes) < self.world * 8:  # conservative floor
+                return "flat"
+        return algo
+
+    def allreduce(self, array, op: int = RED_SUM,
+                  algo: Optional[str] = None) -> None:
+        """In-place ring allreduce of a C-contiguous numpy array.
+
+        ``algo`` overrides the size/topology-aware selector
+        (TDR_ALGO): 'flat' = the native fused/wavefront ring, 'hier' =
+        intra-host reduce-scatter → inter-host delegate-ring allreduce
+        → intra-host all-gather, 'staged' = explicit two-phase
+        reduce-scatter + all-gather on the flat ring. All three are
+        bitwise-identical for exactly-representable sums; float
+        summation ORDER differs across algorithms (as across world
+        sizes), which the schedule digest makes a cross-rank
+        agreement, never a silent divergence."""
+        algo = self._algo_for(int(array.nbytes), algo)
+        if algo == "hier":
+            self._hier_allreduce(array, op)
+            return
+        if algo == "staged":
+            with trace.span("world.allreduce", rank=self.rank,
+                            bytes=int(array.nbytes), algo="staged"):
+                trace.add("algo.staged", 1)
+                ring = self._live_ring()
+                ring.reduce_scatter(array, op)
+                ring.all_gather(array)
+            return
         with trace.span("world.allreduce", rank=self.rank,
                         bytes=int(array.nbytes)):
+            trace.add("algo.flat", 1)
             self._live_ring().allreduce(array, op)
 
-    def allreduce_async(self, array, op: int = RED_SUM) -> "CollectiveHandle":
+    def _hier_allreduce(self, array, op: int = RED_SUM) -> None:
+        """The two-tier schedule, blocking: every phase is the
+        first-class primitive it names, so the composition identity
+        (allreduce ≡ RS; inter-AR on the owned shard; AG) is shared
+        code, not a re-derivation."""
+        intra, inter = self._ensure_tiers()
+        topo = self.topology
+        with trace.span("world.hier_allreduce", rank=self.rank,
+                        bytes=int(array.nbytes), hosts=topo.n_hosts,
+                        local=topo.local_size):
+            trace.add("algo.hier", 1)
+            own = intra.reduce_scatter(array, op)
+            shard = array.reshape(-1)[own]
+            inter.allreduce(shard, op, algo="flat")
+            intra.all_gather(array)
+
+    def allreduce_async(self, array, op: int = RED_SUM,
+                        algo: Optional[str] = None):
         """Nonblocking in-place allreduce: returns a
         :class:`CollectiveHandle` immediately; the wire work proceeds
         on the ring's async driver + progress shards while the caller
@@ -619,13 +1011,57 @@ class RingWorld:
         blocking calls). Do not run other collectives on this world
         until every outstanding handle completed, and wait all handles
         before ``rebuild()``/``close()`` (teardown fails pending
-        handles with a retryable error rather than wedging them)."""
+        handles with a retryable error rather than wedging them).
+
+        With a hierarchical algorithm (selector or ``algo=``), the
+        returned handle is a phase CHAIN: the intra reduce-scatter is
+        submitted immediately; the delegate-ring allreduce and intra
+        all-gather submit as their predecessors complete, in creation
+        order across outstanding handles — per-ring submission order
+        stays deterministic (the SPMD contract) however the caller
+        interleaves test()/wait()."""
+        algo = self._algo_for(int(array.nbytes), algo)
+        if algo in ("hier", "staged"):
+            return _PhasedHandle(self, array, op, hier=algo == "hier")
         ring = self._live_ring()
+        trace.add("algo.flat", 1)
         trace.event("world.allreduce_async", rank=self.rank,
                     bytes=int(array.nbytes))
         rop = ring.allreduce_async(array, op)
         self._async_live += 1
         return CollectiveHandle(self, rop, int(array.nbytes))
+
+    def reduce_scatter_async(self, array,
+                             op: int = RED_SUM) -> "CollectiveHandle":
+        """Nonblocking in-place reduce-scatter on the ring's async
+        driver (submission-order contract as ``allreduce_async``;
+        results bitwise the blocking call's). Read the owned slice
+        with :meth:`owned_slice` — it is a pure function of the
+        layout, available before completion."""
+        ring = self._live_ring()
+        trace.event("world.reduce_scatter_async", rank=self.rank,
+                    bytes=int(array.nbytes))
+        rop = ring.reduce_scatter_async(array, op)
+        self._async_live += 1
+        return CollectiveHandle(self, rop, int(array.nbytes),
+                                what="reduce_scatter")
+
+    def all_gather_async(self, array) -> "CollectiveHandle":
+        """Nonblocking in-place all-gather of per-rank owned segments
+        (the layout ``reduce_scatter`` leaves), on the async driver."""
+        ring = self._live_ring()
+        trace.event("world.all_gather_async", rank=self.rank,
+                    bytes=int(array.nbytes))
+        rop = ring.all_gather_async(array)
+        self._async_live += 1
+        return CollectiveHandle(self, rop, int(array.nbytes),
+                                what="all_gather")
+
+    def owned_slice(self, array) -> slice:
+        """The flat-element slice this rank owns after a
+        reduce-scatter of ``array`` (native segment math — the async
+        twin of ``reduce_scatter``'s return value)."""
+        return self._live_ring().owned_slice(array)
 
     @property
     def pending_async(self) -> int:
@@ -840,6 +1276,12 @@ class RingWorld:
         flushes everything the peers posted against us, so a wedged
         neighbor unblocks promptly instead of riding out the stall
         deadline."""
+        # Tiers die with the incarnation: a delegate (or any tier)
+        # failure escalates to THIS world's rebuild, which must not
+        # leave a previous generation's tier rings alive underneath
+        # the next one. The next hierarchical collective rebuilds
+        # both tiers lazily under the bumped generation.
+        self._close_tiers()
         ring, self.ring = self.ring, None
         lefts, self.left_qps = self.left_qps, []
         rights, self.right_qps = self.right_qps, []

@@ -70,10 +70,22 @@ class ControlClient:
 
     def join(self, world: str, size: int, rank: int = -1,
              host: str = "127.0.0.1",
+             host_key: Optional[str] = None,
              timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """``host_key`` is the member's TOPOLOGY key (which physical
+        host it sits on) — distinct from ``host``, the address peers
+        dial, and deliberately NOT defaulted from it: inferring
+        locality from connect addresses would silently flip collective
+        algorithms under NAT or multi-homed hosts (the resolve_topology
+        design rule). A member with no explicit key reports none, and
+        the coordinator releases a keyless view the member side
+        ignores. The coordinator releases every slot's key in the view
+        (``host_keys``), which is how arbitrated worlds agree on the
+        hierarchical grouping without a per-rank env."""
         budget = self.timeout_s if timeout_s is None else float(timeout_s)
         return self.request("join", timeout_s=budget, world=world,
-                            size=int(size), rank=int(rank), host=host)
+                            size=int(size), rank=int(rank), host=host,
+                            host_key=host_key)
 
     def sync(self, world: str, rank: int, incarnation: int,
              timeout_s: Optional[float] = None) -> Dict[str, Any]:

@@ -65,15 +65,24 @@ PROTOCOL_VERSION = 1
 
 
 class _Member:
-    __slots__ = ("rank", "incarnation", "host", "lease_deadline", "alive",
-                 "waiting", "pending_view", "counters", "hists",
-                 "wait_token")
+    __slots__ = ("rank", "incarnation", "host", "host_key",
+                 "lease_deadline", "alive", "waiting", "pending_view",
+                 "counters", "hists", "wait_token")
 
     def __init__(self, rank: int, incarnation: int, host: str,
-                 lease_deadline: float):
+                 lease_deadline: float, host_key: Optional[str] = None):
         self.rank = rank
         self.incarnation = incarnation
         self.host = host
+        # Topology key (which physical host this member sits on) —
+        # reported at join, released to every member in the view so
+        # the hierarchical grouping is a coordinator decision, not a
+        # per-rank env guess. None when the member reported none — the
+        # dial address is deliberately NOT a fallback (locality
+        # inferred from connect addresses flips algorithms under
+        # NAT/multi-homing); a view with any keyless slot is ignored
+        # by the member-side topology resolution.
+        self.host_key = None if host_key is None else str(host_key)
         self.lease_deadline = lease_deadline
         self.alive = True
         self.waiting = False
@@ -290,6 +299,12 @@ class Coordinator:
             "lease_ms": self.lease_ms,
             "qp_budget": w.qp_budget,
             "peers": [w.members[r].host for r in range(w.size)],
+            # One topology key per slot (join-reported; None for
+            # members that reported none): the member side feeds these
+            # to the hierarchical grouping when no explicit
+            # topology/TDR_TOPOLOGY overrides — and only when EVERY
+            # slot carries a key.
+            "host_keys": [w.members[r].host_key for r in range(w.size)],
         }
         for m in alive:
             m.waiting = False
@@ -375,7 +390,8 @@ class Coordinator:
             elif w.ever_ready:
                 self._membership_changed(w, "rejoin")
             m = _Member(rank, next(self._next_inc), host,
-                        time.monotonic() + self.lease_ms / 1000.0)
+                        time.monotonic() + self.lease_ms / 1000.0,
+                        host_key=req.get("host_key"))
             m.waiting = True
             w.members[rank] = m
             w.joins += 1

@@ -374,6 +374,24 @@ tdr_qp *tdr_listen_timeout(tdr_engine *e, const char *bind_host, int port,
                            int timeout_ms);
 tdr_qp *tdr_connect(tdr_engine *e, const char *host, int port,
                     int timeout_ms);
+
+/* Connection flags for the tiered bring-up variants below. */
+enum {
+  /* Refuse the CMA fast path for this connection even when the probe
+   * would succeed: the QP negotiates the STREAM tier (socket payloads,
+   * full payload seals). The hierarchical inter-host ring uses this so
+   * a two-host topology EMULATED on one machine (host-key override)
+   * still exercises real stream-tier framing — payload CRCs, NAK
+   * retransmit, corrupt riders — on the tier that models the slow
+   * inter-host links. One side forcing is enough: it reports its probe
+   * as failed, so both ends agree on the tier (the handshake's
+   * both-directions-verified rule). */
+  TDR_CONN_FORCE_STREAM = 1 << 0,
+};
+tdr_qp *tdr_listen_tier(tdr_engine *e, const char *bind_host, int port,
+                        int timeout_ms, int flags);
+tdr_qp *tdr_connect_tier(tdr_engine *e, const char *host, int port,
+                         int timeout_ms, int flags);
 int tdr_qp_close(tdr_qp *qp);
 
 /* Work posting. Returns 0 on success, -1 on immediate local failure.
@@ -553,6 +571,25 @@ void tdr_ring_destroy(tdr_ring *r);
 typedef struct tdr_ring_op tdr_ring_op;
 tdr_ring_op *tdr_ring_start(tdr_ring *r, void *data, size_t count,
                             int dtype, int red_op);
+/* Nonblocking standalone phases on the same async driver — the
+ * hierarchical schedule's building blocks (intra-host reduce-scatter
+ * and all-gather overlap the inter-host ring through these). Same
+ * submission-order/SPMD contract, handle surface, and failure
+ * taxonomy as tdr_ring_start; results are bitwise the blocking
+ * phases'. The reduce-scatter handle reports no ownership outparams —
+ * callers read the (pure, layout-deterministic) segment bounds via
+ * tdr_ring_owned_segment below. */
+tdr_ring_op *tdr_ring_start_reduce_scatter(tdr_ring *r, void *data,
+                                           size_t count, int dtype,
+                                           int red_op);
+tdr_ring_op *tdr_ring_start_all_gather(tdr_ring *r, void *data,
+                                       size_t count, int dtype);
+/* The BYTE offset/length of the segment this rank owns after a
+ * reduce-scatter of `count` elements of `dtype` — the same
+ * (rank+1) % world convention and remainder layout the collectives
+ * use, exposed so async callers never re-derive the segment math. */
+int tdr_ring_owned_segment(tdr_ring *r, size_t count, int dtype,
+                           size_t *own_off, size_t *own_len);
 /* 1 = done ok, 0 = still in flight, -1 = failed (error in
  * tdr_last_error and tdr_ring_op_error). */
 int tdr_ring_test(tdr_ring_op *op);

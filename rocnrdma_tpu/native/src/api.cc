@@ -167,23 +167,32 @@ tdr_qp *qp_budget_finish(Engine *e, Qp *q) {
 }  // namespace
 
 tdr_qp *tdr_listen(tdr_engine *e, const char *bind_host, int port) {
-  Engine *eng = reinterpret_cast<Engine *>(e);
-  if (!qp_budget_admit(eng)) return nullptr;
-  return qp_budget_finish(eng, eng->listen(bind_host, port, -1));
+  return tdr_listen_tier(e, bind_host, port, -1, 0);
 }
 
 tdr_qp *tdr_listen_timeout(tdr_engine *e, const char *bind_host, int port,
                            int timeout_ms) {
+  return tdr_listen_tier(e, bind_host, port, timeout_ms, 0);
+}
+
+tdr_qp *tdr_listen_tier(tdr_engine *e, const char *bind_host, int port,
+                        int timeout_ms, int flags) {
   Engine *eng = reinterpret_cast<Engine *>(e);
   if (!qp_budget_admit(eng)) return nullptr;
-  return qp_budget_finish(eng, eng->listen(bind_host, port, timeout_ms));
+  return qp_budget_finish(eng,
+                          eng->listen(bind_host, port, timeout_ms, flags));
 }
 
 tdr_qp *tdr_connect(tdr_engine *e, const char *host, int port,
                     int timeout_ms) {
+  return tdr_connect_tier(e, host, port, timeout_ms, 0);
+}
+
+tdr_qp *tdr_connect_tier(tdr_engine *e, const char *host, int port,
+                         int timeout_ms, int flags) {
   Engine *eng = reinterpret_cast<Engine *>(e);
   if (!qp_budget_admit(eng)) return nullptr;
-  return qp_budget_finish(eng, eng->connect(host, port, timeout_ms));
+  return qp_budget_finish(eng, eng->connect(host, port, timeout_ms, flags));
 }
 
 int tdr_qp_close(tdr_qp *qp) {

@@ -211,9 +211,13 @@ class Engine {
   virtual int dereg_mr(Mr *mr) = 0;
   // timeout_ms bounds the accept wait (-1 = forever): elastic callers
   // (RingWorld.rebuild) must never leak a thread blocked in accept on
-  // a port the next rendezvous attempt needs.
-  virtual Qp *listen(const char *bind_host, int port, int timeout_ms) = 0;
-  virtual Qp *connect(const char *host, int port, int timeout_ms) = 0;
+  // a port the next rendezvous attempt needs. flags: TDR_CONN_* —
+  // TDR_CONN_FORCE_STREAM refuses the CMA fast path for this
+  // connection (the emulated inter-host tier; verbs ignores it).
+  virtual Qp *listen(const char *bind_host, int port, int timeout_ms,
+                     int flags) = 0;
+  virtual Qp *connect(const char *host, int port, int timeout_ms,
+                      int flags) = 0;
   // Seal context (tdr_seal_context): the incarnation+1 and training
   // step stamped into outbound seals and checked at land time. A
   // no-op on engines without sealing (verbs).

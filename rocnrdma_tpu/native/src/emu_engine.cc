@@ -464,8 +464,10 @@ class EmuEngine : public Engine {
     return base + loff;
   }
 
-  Qp *listen(const char *bind_host, int port, int timeout_ms) override;
-  Qp *connect(const char *host, int port, int timeout_ms) override;
+  Qp *listen(const char *bind_host, int port, int timeout_ms,
+             int flags) override;
+  Qp *connect(const char *host, int port, int timeout_ms,
+              int flags) override;
 
  private:
   std::mutex mu_;
@@ -560,7 +562,9 @@ int EmuMr::invalidate() {
 
 class EmuQp : public Qp {
  public:
-  EmuQp(EmuEngine *eng, int fd) : eng_(eng), fd_(fd) {
+  EmuQp(EmuEngine *eng, int fd, int flags = 0)
+      : eng_(eng), fd_(fd),
+        force_stream_((flags & TDR_CONN_FORCE_STREAM) != 0) {
     handshake();
     progress_ = std::thread([this] { progress_loop(); });
   }
@@ -1158,7 +1162,11 @@ class EmuQp : public Qp {
         boot[0] != '\0' &&
         strncmp(mine.boot_id, peer.boot_id, sizeof(mine.boot_id)) == 0;
     uint8_t my_ok = 0;
-    if ((same_process || same_host) && !cma_disabled()) {
+    // TDR_CONN_FORCE_STREAM: report the probe as failed so BOTH ends
+    // resolve to the stream tier (cma_ = mine && theirs) — the
+    // emulated inter-host link keeps full payload seals even when the
+    // peer is actually CMA-reachable (host-key-override topologies).
+    if ((same_process || same_host) && !cma_disabled() && !force_stream_) {
       uint64_t got = 0;
       if (cma_copy_from(peer_pid_, &got, peer.probe_addr, sizeof(got)) &&
           got == peer.probe_val)
@@ -2506,6 +2514,9 @@ class EmuQp : public Qp {
 
   // CMA tier state and negotiated features, fixed at handshake time.
   bool cma_ = false;
+  // TDR_CONN_FORCE_STREAM at bring-up: this side reports its CMA
+  // probe as failed, pinning the connection to the stream tier.
+  bool force_stream_ = false;
   pid_t peer_pid_ = -1;
   uint64_t probe_val_ = 0;
   uint32_t features_ = 0;
@@ -2539,24 +2550,26 @@ class EmuQp : public Qp {
   bool dead_ = false;
 };
 
-Qp *EmuEngine::listen(const char *bind_host, int port, int timeout_ms) {
+Qp *EmuEngine::listen(const char *bind_host, int port, int timeout_ms,
+                      int flags) {
   std::string err;
   int fd = tcp_listen_accept(bind_host, port, &err, timeout_ms);
   if (fd < 0) {
     set_error("listen: " + err);
     return nullptr;
   }
-  return new EmuQp(this, fd);
+  return new EmuQp(this, fd, flags);
 }
 
-Qp *EmuEngine::connect(const char *host, int port, int timeout_ms) {
+Qp *EmuEngine::connect(const char *host, int port, int timeout_ms,
+                       int flags) {
   std::string err;
   int fd = tcp_connect_retry(host, port, timeout_ms, &err);
   if (fd < 0) {
     set_error("connect: " + err);
     return nullptr;
   }
-  return new EmuQp(this, fd);
+  return new EmuQp(this, fd, flags);
 }
 
 }  // namespace

@@ -274,6 +274,12 @@ struct tdr_ring_op {
   size_t count = 0;
   int dtype = 0;
   int red_op = 0;
+  // Which collective the driver runs for this op: the async surface
+  // covers the allreduce AND its standalone phases (the hierarchical
+  // schedule chains reduce-scatter → delegate allreduce → all-gather
+  // through these handles).
+  enum { kAllreduce = 0, kReduceScatter = 1, kAllGather = 2 };
+  int kind = kAllreduce;
   std::mutex mu;
   std::condition_variable cv;
   bool done = false;  // under mu
@@ -317,8 +323,19 @@ void async_driver(tdr_ring *r) {
                       ")");
       continue;
     }
-    int rc = tdr_ring_allreduce(r, op->data, op->count, op->dtype,
+    int rc;
+    switch (op->kind) {
+      case tdr_ring_op::kReduceScatter:
+        rc = tdr_ring_reduce_scatter(r, op->data, op->count, op->dtype,
+                                     op->red_op, nullptr, nullptr);
+        break;
+      case tdr_ring_op::kAllGather:
+        rc = tdr_ring_all_gather(r, op->data, op->count, op->dtype);
+        break;
+      default:
+        rc = tdr_ring_allreduce(r, op->data, op->count, op->dtype,
                                 op->red_op);
+    }
     std::string err = rc == 0 ? std::string() : tdr::get_error();
     if (rc != 0) {
       std::lock_guard<std::mutex> g(r->amu);
@@ -438,8 +455,8 @@ void tdr_ring_destroy(tdr_ring *r) {
   delete r;
 }
 
-tdr_ring_op *tdr_ring_start(tdr_ring *r, void *data, size_t count,
-                            int dtype, int red_op) {
+static tdr_ring_op *ring_start_kind(tdr_ring *r, void *data, size_t count,
+                                    int dtype, int red_op, int kind) {
   if (!r || !data) {
     tdr::set_error("ring_start: null ring or data");
     return nullptr;
@@ -448,7 +465,9 @@ tdr_ring_op *tdr_ring_start(tdr_ring *r, void *data, size_t count,
     tdr::set_error("ring: bad dtype");
     return nullptr;
   }
-  if (dtype == TDR_DT_U8) {
+  // The reducing kinds reject the byte-transport dtype; all_gather
+  // moves bytes only (no folds) and accepts it, like the blocking API.
+  if (dtype == TDR_DT_U8 && kind != tdr_ring_op::kAllGather) {
     tdr::set_error(
         "ring_start: u8 is byte-transport only (no fold semantics)");
     return nullptr;
@@ -458,6 +477,7 @@ tdr_ring_op *tdr_ring_start(tdr_ring *r, void *data, size_t count,
   op->count = count;
   op->dtype = dtype;
   op->red_op = red_op;
+  op->kind = kind;
   {
     std::lock_guard<std::mutex> g(r->amu);
     if (r->astop) {
@@ -473,6 +493,49 @@ tdr_ring_op *tdr_ring_start(tdr_ring *r, void *data, size_t count,
   }
   r->acv.notify_all();
   return op;
+}
+
+tdr_ring_op *tdr_ring_start(tdr_ring *r, void *data, size_t count,
+                            int dtype, int red_op) {
+  return ring_start_kind(r, data, count, dtype, red_op,
+                         tdr_ring_op::kAllreduce);
+}
+
+tdr_ring_op *tdr_ring_start_reduce_scatter(tdr_ring *r, void *data,
+                                           size_t count, int dtype,
+                                           int red_op) {
+  return ring_start_kind(r, data, count, dtype, red_op,
+                         tdr_ring_op::kReduceScatter);
+}
+
+tdr_ring_op *tdr_ring_start_all_gather(tdr_ring *r, void *data,
+                                       size_t count, int dtype) {
+  return ring_start_kind(r, data, count, dtype, TDR_RED_SUM,
+                         tdr_ring_op::kAllGather);
+}
+
+int tdr_ring_owned_segment(tdr_ring *r, size_t count, int dtype,
+                           size_t *own_off, size_t *own_len) {
+  if (!r) {
+    tdr::set_error("ring_owned_segment: null ring");
+    return -1;
+  }
+  size_t esz = dtype_size(dtype);
+  if (esz == 0) {
+    tdr::set_error("ring: bad dtype");
+    return -1;
+  }
+  // Same layout math the collectives run (seg_layout + the
+  // (rank+1) % world ownership convention) — one source of truth, so
+  // async callers can never drift from what reduce_scatter leaves.
+  size_t base = count / static_cast<size_t>(r->world);
+  size_t rem = count % static_cast<size_t>(r->world);
+  size_t own = static_cast<size_t>((r->rank + 1) % r->world);
+  size_t off = own * base + std::min(own, rem);
+  size_t len = base + (own < rem ? 1 : 0);
+  if (own_off) *own_off = off * esz;
+  if (own_len) *own_len = len * esz;
+  return 0;
 }
 
 int tdr_ring_test(tdr_ring_op *op) {

@@ -59,6 +59,9 @@ RED_SUM, RED_MAX, RED_MIN = 0, 1, 2
 SCHED_NONE, SCHED_GENERIC, SCHED_FUSED2, SCHED_FUSED2_FB, SCHED_WAVEFRONT = \
     0, 1, 2, 3, 4
 
+# Connection flags (tdr_listen_tier/tdr_connect_tier).
+_CONN_FORCE_STREAM = 1
+
 _NUMPY_DTYPE_MAP = {
     "float32": DT_F32,
     "float64": DT_F64,
@@ -157,6 +160,12 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_listen_timeout.restype = P
     lib.tdr_listen_timeout.argtypes = [P, ctypes.c_char_p, ctypes.c_int,
                                        ctypes.c_int]
+    lib.tdr_listen_tier.restype = P
+    lib.tdr_listen_tier.argtypes = [P, ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int]
+    lib.tdr_connect_tier.restype = P
+    lib.tdr_connect_tier.argtypes = [P, ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_int, ctypes.c_int]
     lib.tdr_fault_plan_clauses.restype = ctypes.c_int
     lib.tdr_fault_plan_hits.restype = ctypes.c_uint64
     lib.tdr_fault_plan_hits.argtypes = [ctypes.c_int]
@@ -229,6 +238,18 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_start.restype = P
     lib.tdr_ring_start.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.tdr_ring_start_reduce_scatter.restype = P
+    lib.tdr_ring_start_reduce_scatter.argtypes = \
+        lib.tdr_ring_start.argtypes
+    lib.tdr_ring_start_all_gather.restype = P
+    lib.tdr_ring_start_all_gather.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_int,
+    ]
+    lib.tdr_ring_owned_segment.restype = ctypes.c_int
+    lib.tdr_ring_owned_segment.argtypes = [
+        P, ctypes.c_size_t, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_size_t),
     ]
     lib.tdr_ring_test.restype = ctypes.c_int
     lib.tdr_ring_test.argtypes = [P]
@@ -1013,6 +1034,43 @@ class Ring:
         _check(h, "ring_start")
         return RingOp(h, array)
 
+    def reduce_scatter_async(self, array, op: int = RED_SUM) -> "RingOp":
+        """Nonblocking reduce-scatter on the same async driver (and
+        under the same submission-order SPMD contract) as
+        ``allreduce_async``. The ownership layout is the blocking
+        call's; read the owned slice with ``owned_slice``."""
+        ptr, dt = self._array_args(array, "reduce_scatter_async")
+        h = _load().tdr_ring_start_reduce_scatter(
+            _live(self._h, "ring_start_reduce_scatter"), ptr, array.size,
+            dt, op)
+        _check(h, "ring_start_reduce_scatter")
+        return RingOp(h, array)
+
+    def all_gather_async(self, array) -> "RingOp":
+        """Nonblocking all-gather (the reduce-scatter's phase-2 twin)
+        on the async driver; assumes the ownership layout
+        ``reduce_scatter`` leaves."""
+        ptr, dt = self._array_args(array, "all_gather_async")
+        h = _load().tdr_ring_start_all_gather(
+            _live(self._h, "ring_start_all_gather"), ptr, array.size, dt)
+        _check(h, "ring_start_all_gather")
+        return RingOp(h, array)
+
+    def owned_slice(self, array) -> slice:
+        """The flat-element slice this rank owns after a reduce-scatter
+        of ``array`` — the native layout math (segment (rank+1) % world
+        with remainder distribution), so async callers never re-derive
+        it in Python."""
+        _, dt = self._array_args(array, "owned_slice")
+        off = ctypes.c_size_t()
+        length = ctypes.c_size_t()
+        rc = _load().tdr_ring_owned_segment(
+            _live(self._h, "ring_owned_segment"), array.size, dt,
+            ctypes.byref(off), ctypes.byref(length))
+        _check(rc == 0, "ring_owned_segment")
+        isz = array.itemsize
+        return slice(off.value // isz, (off.value + length.value) // isz)
+
     def _array_args(self, array, what: str, need_dtype: bool = True):
         import numpy as np
 
@@ -1227,19 +1285,28 @@ class Engine:
         _load().tdr_seal_context(_live(self._h, "seal_context"), 0, 0)
 
     def listen(self, host: str = "127.0.0.1", port: int = 0,
-               timeout_ms: int = -1) -> QueuePair:
+               timeout_ms: int = -1,
+               force_stream: bool = False) -> QueuePair:
         """Accept one connection (blocking). ``timeout_ms`` bounds the
         accept wait (-1 = forever): elastic rendezvous must be able to
-        give up and release the port for the next attempt."""
-        h = _load().tdr_listen_timeout(_live(self._h, "listen"),
-                                       host.encode(), port, timeout_ms)
+        give up and release the port for the next attempt.
+        ``force_stream`` pins the connection to the stream tier (no
+        CMA fast path — full payload seals; the emulated inter-host
+        link of a hierarchical topology)."""
+        h = _load().tdr_listen_tier(_live(self._h, "listen"),
+                                    host.encode(), port, timeout_ms,
+                                    _CONN_FORCE_STREAM if force_stream
+                                    else 0)
         _check(h, "listen")
         return QueuePair(self, h)
 
     def connect(self, host: str = "127.0.0.1", port: int = 0,
-                timeout_ms: int = 10000) -> QueuePair:
-        h = _load().tdr_connect(_live(self._h, "connect"), host.encode(),
-                                port, timeout_ms)
+                timeout_ms: int = 10000,
+                force_stream: bool = False) -> QueuePair:
+        h = _load().tdr_connect_tier(_live(self._h, "connect"),
+                                     host.encode(), port, timeout_ms,
+                                     _CONN_FORCE_STREAM if force_stream
+                                     else 0)
         _check(h, "connect")
         return QueuePair(self, h)
 

@@ -115,3 +115,29 @@ def test_soak_world8_flap_two_faults_concurrent_parity(tmp_path):
     assert stats["ctl"].get("ctl.rebuild", 0) >= 1, stats
     assert len(stats["generations"]) == 1, stats
     assert stats["generations"][0] >= 1, stats
+
+
+@pytest.mark.slow
+def test_soak_topology_delegate_flap_parity(tmp_path):
+    """The hierarchical elastic ladder (ROADMAP item 1 / PR 9
+    satellite): a world-4 two-host-emulated soak (``--topology
+    a,a,b,b``) where rank 2 — host b's delegate for shard 0, a member
+    of BOTH its intra ring and an inter-host delegate ring — tears its
+    transport down mid-step. Peers surface retryable tier failures,
+    the rebuild brings the flat ring AND both tier rings back under
+    the next generation, and the run converges bitwise-equal to the
+    clean (also hierarchical) run. ``hier_collectives`` proves the
+    two-tier schedule actually carried the gradient syncs."""
+    steps, seed = 2, 21
+    clean, cstats = fs.run_soak(steps=steps, seed=seed, world=4,
+                                ckpt_dir=str(tmp_path / "clean"),
+                                topology="a,a,b,b")
+    assert cstats["hier_collectives"] >= 1, cstats
+    faulty, stats = fs.run_soak(steps=steps, seed=seed, world=4,
+                                ckpt_dir=str(tmp_path / "faulty"),
+                                flap=(2, 2), topology="a,a,b,b")
+    assert fs.params_equal(clean, faulty)
+    assert stats["resumes"] >= 1, stats
+    assert stats["rebuilds"] >= 1, stats
+    assert stats["hier_collectives"] >= 1, stats
+    assert stats["flapped"] and stats["topology"] == "a,a,b,b"

@@ -1,0 +1,506 @@
+"""Hierarchical topology-aware allreduce (ROADMAP item 1).
+
+World 8 emulated as TWO HOSTS via the host-key override
+(``TDR_TOPOLOGY=a,a,a,a,b,b,b,b``): the two-tier schedule — intra-host
+reduce-scatter → inter-host delegate-ring allreduce over the owned
+shard → intra-host all-gather — must be BITWISE the flat ring's result
+on exactly-representable sums, blocking and async-chained, across
+dtypes, bucket splits, and the bf16 wire; the schedule digest must
+diverge when the topology or the algorithm selector changes (and stay
+byte-identical for legacy flat worlds); sealing must hold PER TIER
+(CMA intra rings tag-only, the forced-stream inter rings full payload
+CRC); and the standalone async reduce-scatter/all-gather primitives
+must compose, in submission order, to the allreduce bit-for-bit.
+"""
+
+import hashlib
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.collectives.topology import (TopologyMap, algo_stamp,
+                                               choose_algo,
+                                               hier_min_bytes,
+                                               parse_env_topology,
+                                               resolve_topology)
+from rocnrdma_tpu.collectives.world import (RingWorld, auto_channel_cap,
+                                            local_worlds)
+from rocnrdma_tpu.transport.engine import TransportError
+
+KEYS8 = ["a", "a", "a", "a", "b", "b", "b", "b"]
+
+
+def port_band(span: int, lo: int = 21000, hi: int = 29000) -> int:
+    """Bind-probe a CONTIGUOUS free port band below the ephemeral
+    range. A hierarchical world listens across base..base+span (flat
+    ring + per-group intra arenas + per-local-index inter arenas);
+    taking base from an ephemeral free_port() invites a later bind in
+    the span to collide with kernel-assigned client ports — the
+    classic "one rank's listener stolen → digest hop wedges for the
+    full stall deadline" flake. Probing the whole span in a quiet
+    range makes the collision a retried probe, not a 30 s timeout."""
+    import random
+    import socket
+
+    rng = random.Random()
+    for _ in range(128):
+        base = rng.randrange(lo, hi - span)
+        socks = []
+        try:
+            for p in range(base, base + span):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", p))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no free {span}-port band in [{lo}, {hi})")
+
+
+def hier_worlds(n, keys, channels=1, tries=3, **kwargs):
+    """Bring up an n-rank multi-host-emulated world on a probed port
+    band. The topology rides the EXPLICIT ``topology=`` parameter —
+    never the process-wide TDR_TOPOLOGY env, which a mid-bring-up
+    failure would leak into every other test's (differently-sized)
+    worlds — and explicit topology also survives rebuild()'s
+    re-resolution. Transient bring-up failures retry on a fresh
+    band."""
+    last = None
+    for _ in range(tries):
+        # Flat ring n ports + intra arenas n*hosts + inter arenas
+        # local*hosts = n*(2 + hosts) worst-case span; pad a bit.
+        base = port_band(n * 4 + 8)
+        try:
+            return local_worlds(n, base, channels=channels,
+                                topology=list(keys), **kwargs)
+        except (TransportError, TimeoutError, OSError) as e:
+            last = e
+    raise last
+
+
+def run_all(worlds, fn):
+    """Run fn(rank) on one thread per rank; re-raise the first error."""
+    errs = [None] * len(worlds)
+
+    def body(r):
+        try:
+            fn(r)
+        except BaseException as e:  # surfaced after join
+            errs[r] = e
+
+    ts = [threading.Thread(target=body, args=(r,))
+          for r in range(len(worlds))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for e in errs:
+        if e is not None:
+            raise e
+
+
+@pytest.fixture(scope="module")
+def world8():
+    """A world-8 two-host-emulated ring, channels=1 (the suite must
+    pass on core-starved CI; channel scaling is bench.py's subject).
+    Module-scoped: bring-up of 8 ranks plus 2+4 tier rings is the
+    expensive part, and every test here runs the same SPMD sequence
+    on it. Explicit topology= (not env — unleakable) on a probed
+    port band (not an ephemeral base — uncollidable), per the
+    hier_worlds rationale."""
+    worlds = hier_worlds(8, KEYS8)
+    try:
+        yield worlds
+    finally:
+        for w in worlds:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+
+# ------------------------------------------------------------- units
+
+
+def test_topology_map_groups_and_delegate_rings():
+    t = TopologyMap(KEYS8, rank=5)
+    assert t.n_hosts == 2 and t.local_size == 4 and t.uniform
+    assert t.hierarchical
+    assert t.group == [4, 5, 6, 7] and t.local_rank == 1
+    assert t.host_index == 1
+    # Delegate ring for local index 1: rank 1 of every host.
+    assert t.delegate_ring() == [1, 5]
+    # Every rank derives the same host order (first appearance).
+    assert TopologyMap(KEYS8, rank=0).hosts == t.hosts == ["a", "b"]
+    # Non-hierarchical shapes: one host, singleton groups, uneven.
+    assert not TopologyMap(["a"] * 4, 0).hierarchical
+    assert not TopologyMap(["a", "b", "c", "d"], 0).hierarchical
+    uneven = TopologyMap(["a", "a", "a", "b"], 0)
+    assert not uneven.uniform and not uneven.hierarchical
+    # The stamp fingerprints the key list (digest divergence input).
+    assert TopologyMap(KEYS8, 0).stamp() != \
+        TopologyMap(["a"] * 2 + ["b"] * 2 + ["c"] * 4, 0).stamp()
+
+
+def test_parse_env_topology_rejects_wrong_length(monkeypatch):
+    monkeypatch.setenv("TDR_TOPOLOGY", "a,a,b")
+    with pytest.raises(ValueError):
+        parse_env_topology(4)
+    monkeypatch.setenv("TDR_TOPOLOGY", ",".join(KEYS8))
+    assert parse_env_topology(8) == KEYS8
+    monkeypatch.delenv("TDR_TOPOLOGY")
+    assert parse_env_topology(8) is None
+
+
+def test_choose_algo_size_switch_and_overrides(monkeypatch):
+    topo = TopologyMap(KEYS8, 0)
+    monkeypatch.delenv("TDR_ALGO", raising=False)
+    monkeypatch.delenv("TDR_HIER_MIN_BYTES", raising=False)
+    thr = hier_min_bytes()
+    assert choose_algo(thr - 1, topo) == "flat"
+    assert choose_algo(thr, topo) == "hier"
+    # Flat topology never goes hier, whatever the size or override.
+    assert choose_algo(thr * 16, None) == "flat"
+    monkeypatch.setenv("TDR_ALGO", "hier")
+    assert choose_algo(1, topo) == "hier"
+    assert choose_algo(1 << 30, None) == "flat"
+    monkeypatch.setenv("TDR_ALGO", "flat")
+    assert choose_algo(1 << 30, topo) == "flat"
+    monkeypatch.setenv("TDR_ALGO", "staged")
+    assert choose_algo(1, topo) == "staged"
+    monkeypatch.setenv("TDR_ALGO", "bogus")
+    with pytest.raises(ValueError):
+        choose_algo(1, topo)
+    # The threshold moves the switch (and the digest term with it).
+    monkeypatch.setenv("TDR_ALGO", "auto")
+    monkeypatch.setenv("TDR_HIER_MIN_BYTES", "64")
+    assert choose_algo(64, topo) == "hier"
+    assert "64" in algo_stamp(topo)
+
+
+def test_auto_channel_cap_divides_across_live_rings(monkeypatch):
+    monkeypatch.setattr(os, "sched_getaffinity", lambda pid: set(range(8)))
+    monkeypatch.delenv("TDR_RING_CHANNELS", raising=False)
+    peers = ["127.0.0.1"] * 4  # 4 local ranks
+    assert auto_channel_cap(peers, 0) == 2          # 8 cores / 4 local
+    # Two concurrently live rings (intra + delegate): the budget
+    # splits instead of each ring claiming cores/local independently.
+    assert auto_channel_cap(peers, 0, rings=2) == 1
+    assert auto_channel_cap(["h1", "h2"], 0, rings=2) == 4
+
+
+def test_resolve_topology_sources(monkeypatch):
+    monkeypatch.delenv("TDR_TOPOLOGY", raising=False)
+    # No source -> flat; peer ADDRESSES are deliberately not one.
+    assert resolve_topology(4, 0) is None
+    # Coordinator view keys engage when nothing overrides.
+    t = resolve_topology(4, 2, view_keys=["a", "a", "b", "b"])
+    assert t is not None and t.hierarchical and t.host_index == 1
+    # Explicit beats env; env beats view.
+    monkeypatch.setenv("TDR_TOPOLOGY", "a,b,a,b")
+    t = resolve_topology(4, 0, view_keys=["a", "a", "b", "b"])
+    assert t.group == [0, 2]
+    t = resolve_topology(4, 0, explicit=["x", "x", "y", "y"])
+    assert t.group == [0, 1]
+
+
+# ------------------------------------------- world-8 bitwise parity
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32"])
+def test_world8_hier_flat_staged_bitwise_parity(world8, dtype):
+    """The three algorithms agree bit-for-bit on exactly-representable
+    sums (small integers: every partial sum exact in every order), so
+    the hierarchical re-association is invisible where it must be."""
+    rng = np.random.default_rng(3)
+    data = rng.integers(-100, 100, (8, 4099)).astype(dtype)  # odd len:
+    expect = data.sum(axis=0).astype(dtype)  # remainder segments too
+    results = {}
+    for algo in ("flat", "hier", "staged"):
+        bufs = [data[r].copy() for r in range(8)]
+        run_all(world8,
+                lambda r: world8[r].allreduce(bufs[r], algo=algo))
+        assert all(np.array_equal(b, expect) for b in bufs), algo
+        results[algo] = bufs[0].tobytes()
+    assert results["hier"] == results["flat"] == results["staged"]
+
+
+def test_world8_hier_async_chain_parity_and_census(world8):
+    """Three buckets per rank launched back-to-back as chained async
+    hier handles (phase submissions ordered across handles), waited in
+    order — bitwise the blocking flat result; the handle-leak census
+    returns to zero on every world including the tiers."""
+    rng = np.random.default_rng(5)
+    data = rng.integers(-50, 50, (8, 3, 2048)).astype(np.float32)
+    flat = [[data[r, k].copy() for k in range(3)] for r in range(8)]
+    for k in range(3):
+        run_all(world8, lambda r: world8[r].allreduce(flat[r][k],
+                                                      algo="flat"))
+    hier = [[data[r, k].copy() for k in range(3)] for r in range(8)]
+
+    def launch(r):
+        hs = [world8[r].allreduce_async(hier[r][k], algo="hier")
+              for k in range(3)]
+        for h in hs:
+            h.wait()
+
+    run_all(world8, launch)
+    for r in range(8):
+        for k in range(3):
+            assert hier[r][k].tobytes() == flat[0][k].tobytes()
+    for w in world8:
+        assert w.pending_async == 0
+        for tier in (w._tier_intra, w._tier_inter):
+            assert tier is not None and tier.pending_async == 0
+
+
+def test_per_tier_sealing_and_tier_shape(world8):
+    """After any hierarchical collective: the intra ring negotiated
+    the CMA tier (tag-only — has_seal_payload False), the inter
+    delegate ring is PINNED to the stream tier (full payload seals)
+    even though every rank here is CMA-reachable; ring shapes match
+    the topology map."""
+    bufs = [np.ones(1024, dtype=np.float32) for _ in range(8)]
+    run_all(world8, lambda r: world8[r].allreduce(bufs[r], algo="hier"))
+    for r, w in enumerate(world8):
+        intra, inter = w._tier_intra, w._tier_inter
+        assert intra is not None and inter is not None
+        assert intra.world == 4 and inter.world == 2
+        assert intra.left_qp.has_seal and not \
+            intra.left_qp.has_seal_payload
+        assert inter.left_qp.has_seal and inter.left_qp.has_seal_payload
+        assert w.topology.hierarchical
+        assert intra.rank == w.topology.local_rank
+        assert inter.rank == w.topology.host_index
+
+
+# ------------------------------- async RS/AG first-class primitives
+
+
+def test_rs_ag_async_submission_order_composes_to_allreduce():
+    """World-4 flat ring: reduce_scatter_async + all_gather_async
+    queued back-to-back (submission order IS the contract — the AG
+    executes after the RS on the per-ring driver) compose bitwise to
+    the blocking allreduce; owned_slice matches what the blocking
+    reduce_scatter returns."""
+    worlds = local_worlds(4, port_band(8), channels=1, topology="flat")
+    try:
+        rng = np.random.default_rng(11)
+        data = rng.integers(-100, 100, (4, 2051)).astype(np.float32)
+        ar = [data[r].copy() for r in range(4)]
+        run_all(worlds, lambda r: worlds[r].allreduce(ar[r]))
+
+        own_blocking = [None] * 4
+        rs = [data[r].copy() for r in range(4)]
+        run_all(worlds, lambda r: own_blocking.__setitem__(
+            r, worlds[r].reduce_scatter(rs[r])))
+        for r in range(4):
+            assert worlds[r].owned_slice(rs[r]) == own_blocking[r]
+
+        comp = [data[r].copy() for r in range(4)]
+
+        def chain(r):
+            h1 = worlds[r].reduce_scatter_async(comp[r])
+            h2 = worlds[r].all_gather_async(comp[r])
+            h1.wait()
+            h2.wait()
+
+        run_all(worlds, chain)
+        for r in range(4):
+            assert comp[r].tobytes() == ar[0].tobytes()
+        assert all(w.pending_async == 0 for w in worlds)
+    finally:
+        for w in worlds:
+            w.close()
+
+
+# --------------------------------------------------- digest behavior
+
+
+def _describe(world):
+    from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+
+    shim = CrossSliceAllReduce(world)
+    return shim._sched_describe([], [], [], {}, 16 << 20, wire=None)
+
+
+def test_digest_diverges_on_topology_and_algo(monkeypatch):
+    """topo=/algo= join the schedule digest exactly when the world is
+    hierarchical: a changed key list or TDR_ALGO mode changes the
+    digest (fail-fast at the first collective), while a flat legacy
+    world's describe string carries neither term — byte-identical to
+    pre-hier digests."""
+    monkeypatch.delenv("TDR_ALGO", raising=False)
+    monkeypatch.setenv("TDR_TOPOLOGY", "a,a,b,b")
+    worlds = local_worlds(4, port_band(24), channels=1)
+    try:
+        base = _describe(worlds[0])
+        assert "topo=h2x2" in base and "algo=auto" in base
+        monkeypatch.setenv("TDR_ALGO", "hier")
+        d_hier = _describe(worlds[0])
+        assert "algo=hier" in d_hier and d_hier != base
+        monkeypatch.setenv("TDR_ALGO", "auto")
+        monkeypatch.setenv("TDR_HIER_MIN_BYTES", "4096")
+        assert _describe(worlds[0]) != base  # threshold moves digest
+        monkeypatch.delenv("TDR_HIER_MIN_BYTES")
+        # A different topology (same shape class) -> different digest.
+        worlds[0].topology = TopologyMap(["x", "x", "y", "y"], 0)
+        assert _describe(worlds[0]) != base
+        assert hashlib.sha256(base.encode()).digest() != \
+            hashlib.sha256(_describe(worlds[0]).encode()).digest()
+    finally:
+        for w in worlds:
+            w.close()
+    monkeypatch.delenv("TDR_TOPOLOGY")
+    worlds = local_worlds(2, port_band(4), channels=1)
+    try:
+        legacy = _describe(worlds[0])
+        assert "topo=" not in legacy and "algo=" not in legacy
+    finally:
+        for w in worlds:
+            w.close()
+
+
+# ------------------------------------------ overlap + bf16 wire path
+
+
+@pytest.mark.parametrize("bucket_bytes", [None, 2048])
+def test_world8_overlap_bf16_hier_vs_flat_bitwise(world8, monkeypatch,
+                                                  bucket_bytes):
+    """The acceptance pin: CrossSliceAllReduce(overlap=True) with
+    TDR_WIRE_DTYPE=bf16 produces BITWISE identical trees under
+    TDR_ALGO=hier and TDR_ALGO=flat at world 8 — one big bucket and a
+    multi-bucket split (the chained hier handles ride the bucketed
+    launch path). Inputs are small integers: exact in bf16 at every
+    fold order, so re-association cannot hide behind tolerance."""
+    from rocnrdma_tpu.collectives.jax_shim import CrossSliceAllReduce
+
+    rng = np.random.default_rng(9)
+    trees = [[rng.integers(-4, 5, 1500).astype(np.float32),
+              rng.integers(-4, 5, 700).astype(np.float32)]
+             for _ in range(8)]
+    outs = {}
+    for algo in ("flat", "hier"):
+        monkeypatch.setenv("TDR_ALGO", algo)
+        shims = [CrossSliceAllReduce(world8[r], overlap=True,
+                                     bucket_bytes=bucket_bytes,
+                                     wire_dtype="bf16")
+                 for r in range(8)]
+        res = [None] * 8
+
+        def sync(r):
+            res[r] = shims[r]([a.copy() for a in trees[r]])
+
+        run_all(world8, sync)
+        for s in shims:
+            s.close()
+        outs[algo] = res
+    for r in range(8):
+        for a, b in zip(outs["flat"][r], outs["hier"][r]):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    assert all(w.pending_async == 0 for w in world8)
+
+
+# --------------------------------------------------------- elasticity
+
+
+def test_hier_rebuild_rebuilds_both_tiers_bitwise():
+    """Tear-down mid-life surfaces retryable; rebuild() brings the
+    flat ring AND both tier rings back under the bumped generation,
+    and the hierarchical result is bitwise the pre-rebuild one."""
+    worlds = hier_worlds(4, ["a", "a", "b", "b"])
+    try:
+        rng = np.random.default_rng(13)
+        data = rng.integers(-100, 100, (4, 4096)).astype(np.float32)
+        bufs = [data[r].copy() for r in range(4)]
+        run_all(worlds, lambda r: worlds[r].allreduce(bufs[r],
+                                                      algo="hier"))
+        gen0 = worlds[0].generation
+        assert worlds[0]._tier_gen == gen0
+        # A torn-down incarnation fails hier collectives RETRYABLE
+        # (the elastic ladder's entry condition), not AttributeError.
+        worlds[0]._teardown()
+        with pytest.raises(TransportError) as ei:
+            worlds[0].allreduce(data[0].copy(), algo="hier")
+        assert ei.value.retryable
+        assert worlds[0]._tier_intra is None  # tiers died with it
+        run_all(worlds, lambda r: worlds[r].rebuild(
+            max_attempts=6, backoff_s=0.05))
+        bufs2 = [data[r].copy() for r in range(4)]
+        run_all(worlds, lambda r: worlds[r].allreduce(bufs2[r],
+                                                      algo="hier"))
+        assert worlds[0].generation == gen0 + 1
+        assert worlds[0]._tier_gen == gen0 + 1
+        for r in range(4):
+            assert bufs2[r].tobytes() == bufs[0].tobytes()
+    finally:
+        for w in worlds:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+
+def test_coordinator_view_carries_host_keys():
+    """Arbitrated worlds agree on the grouping through the released
+    view: members report host keys at join, every slot's key comes
+    back in ``host_keys``, and the member side resolves the same
+    TopologyMap from them with no TDR_TOPOLOGY env at all."""
+    from rocnrdma_tpu.control.coordinator import Coordinator
+    from rocnrdma_tpu.transport.engine import Engine
+
+    prev = os.environ.pop("TDR_TOPOLOGY", None)
+    coord = Coordinator(port=0, lease_ms=4000,
+                        port_base=port_band(64)).start()
+    engines = [Engine("emu") for _ in range(4)]
+    worlds = [None] * 4
+    errs = [None] * 4
+    keys = ["hostA", "hostA", "hostB", "hostB"]
+    try:
+        def boot(r):
+            try:
+                worlds[r] = RingWorld(
+                    engines[r], r, 4, None, controller=coord.address,
+                    world_name="hier", timeout_ms=20000, channels=1,
+                    topology=keys)
+            except BaseException as e:
+                errs[r] = e
+
+        ts = [threading.Thread(target=boot, args=(r,)) for r in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for e in errs:
+            if e is not None:
+                raise e
+        for r, w in enumerate(worlds):
+            assert w._ctl_host_keys == keys
+            assert w.topology is not None and w.topology.hierarchical
+            assert w.topology.group == ([0, 1] if r < 2 else [2, 3])
+        # Parity through the arbitrated, view-derived topology.
+        rng = np.random.default_rng(17)
+        data = rng.integers(-100, 100, (4, 2048)).astype(np.float32)
+        expect = data.sum(axis=0)
+        bufs = [data[r].copy() for r in range(4)]
+        run_all(worlds, lambda r: worlds[r].allreduce(bufs[r],
+                                                      algo="hier"))
+        assert all(np.array_equal(b, expect) for b in bufs)
+    finally:
+        for w in worlds:
+            if w is not None:
+                try:
+                    w.close()
+                except Exception:
+                    pass
+        for e in engines:
+            e.close()
+        coord.stop()
+        if prev is not None:
+            os.environ["TDR_TOPOLOGY"] = prev

@@ -139,9 +139,13 @@ def _run_side_world(engines, world, steps, seed, base_port, controller,
 
     def side_rank(r):
         try:
+            # topology="flat": the side world proves co-tenancy, and a
+            # --topology soak must not have it carve tier port arenas
+            # overlapping the training world's.
             w = RingWorld(engines[r], r, world, base_port,
                           timeout_ms=20000, channels=1,
-                          controller=controller, world_name="side")
+                          controller=controller, world_name="side",
+                          topology="flat")
             worlds[r] = w
             for i in range(iters):
                 buf = data[i, r].copy()
@@ -178,7 +182,7 @@ def _run_side_world(engines, world, steps, seed, base_port, controller,
 def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
              fault_plan=None, config: str = "llama-tiny", world: int = 2,
              coordinator=None, flap=None, concurrent: bool = False,
-             channels=None):
+             channels=None, topology=None):
     """Train ``steps`` steps of world-N DP (in-process ring) with the
     elastic policy armed, optionally under ``fault_plan`` and the
     chaos riders. Returns ``(params, stats)``: rank 0's final params
@@ -189,7 +193,14 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
     ``coordinator``: None (legacy pairwise path), True (spawn an
     in-process Coordinator), or a "host:port" address. ``flap``: a
     (rank, nth_sync) tuple arming a FlapRider. ``concurrent``: run the
-    "side" world over the same engines for the whole soak."""
+    "side" world over the same engines for the whole soak.
+    ``topology``: a host-key string ("a,a,b,b") arming the
+    HIERARCHICAL schedule for every gradient sync (TDR_TOPOLOGY +
+    TDR_ALGO=hier for the run) — pair it with ``flap`` on a delegate
+    rank to prove the per-tier elastic ladder: the flap tears the flat
+    ring AND both tier rings down mid-step, peers surface retryable
+    tier failures, and the rebuild brings all three back under the
+    next generation."""
     import jax
     import numpy as np
 
@@ -227,9 +238,17 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
         os.environ["TDR_FAULT_PLAN"] = fault_plan
     else:
         os.environ.pop("TDR_FAULT_PLAN", None)
+    prev_topo = {k: os.environ.get(k)
+                 for k in ("TDR_TOPOLOGY", "TDR_ALGO")}
+    if topology:
+        os.environ["TDR_TOPOLOGY"] = str(topology)
+        # Force the two-tier schedule regardless of gradient size —
+        # the soak's buffers are far below the auto threshold.
+        os.environ["TDR_ALGO"] = "hier"
     fault_plan_reset()
     resumes0 = trace.counter("trainer.resume")
     rebuilds0 = trace.counter("world.rebuild")
+    hier0 = trace.counter("algo.hier")
     ctl0 = trace.counters_prefixed("ctl.")
     seal0 = seal_counters()
 
@@ -284,9 +303,14 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
                for r in range(world)]
     try:
         if concurrent:
+            # Side-world port arena BEYOND the training world's tier
+            # arenas (a hierarchical world carves base + world*(1+g)
+            # and base + world*(1+hosts) + l*hosts for its tier
+            # rings; world*(2 + world//2) upper-bounds that span).
             side_threads, side_finish = _run_side_world(
                 engines, world, steps, seed,
-                None if ctl_address else base_port + world + 8,
+                None if ctl_address
+                else base_port + world * (2 + world // 2) + 8,
                 ctl_address, side_errs)
         for t in threads:
             t.start()
@@ -303,6 +327,12 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
             os.environ.pop("TDR_FAULT_PLAN", None)
         else:
             os.environ["TDR_FAULT_PLAN"] = prev_plan
+        for k, v in prev_topo.items():
+            if topology:
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         fault_plan_reset()
         for eng in engines:
             try:
@@ -340,6 +370,10 @@ def run_soak(steps: int = 4, seed: int = 0, base_port=None, ckpt_dir=None,
         "generations": gens,
         "flapped": bool(flap),
         "side_ok": concurrent and all(e is None for e in side_errs),
+        # Hierarchical collectives actually ran (a --topology soak
+        # whose syncs silently fell back to flat would prove nothing).
+        "hier_collectives": trace.counter("algo.hier") - hier0,
+        "topology": topology or None,
     }
     return results[0], stats
 
@@ -370,6 +404,14 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrent", action="store_true",
                     help="run a second named world over the same "
                          "engines for the whole soak")
+    ap.add_argument("--topology", default=None, metavar="KEYS",
+                    help="host-key list ('a,a,b,b', one key per rank): "
+                         "run every gradient sync on the HIERARCHICAL "
+                         "schedule (two emulated hosts, per-tier "
+                         "rings); both the clean and the faulty run "
+                         "use it, so the bitwise parity predicate "
+                         "covers delegate-rank failure + per-tier "
+                         "rebuild")
     ap.add_argument("--perfetto", default=None, metavar="PATH",
                     help="write a merged Perfetto trace of the faulty "
                          "run (ctl.* arbitration events included)")
@@ -392,14 +434,21 @@ def main(argv=None) -> int:
             f":corrupt={rng.randrange(1, 5)}" for k in (1, 4))
     else:
         plan = make_fault_plan(args.seed, args.steps, args.world)
+    if args.topology:
+        keys = [k for k in args.topology.split(",") if k]
+        if len(keys) != args.world:
+            ap.error(f"--topology needs {args.world} comma-separated "
+                     f"keys, got {len(keys)}")
     with tempfile.TemporaryDirectory(prefix="tdr_soak_") as d:
         clean, _ = run_soak(args.steps, args.seed, world=args.world,
-                            ckpt_dir=os.path.join(d, "clean"))
+                            ckpt_dir=os.path.join(d, "clean"),
+                            topology=args.topology)
         faulty, stats = run_soak(args.steps, args.seed, world=args.world,
                                  ckpt_dir=os.path.join(d, "faulty"),
                                  fault_plan=plan or None,
                                  coordinator=args.coordinator,
-                                 flap=flap, concurrent=args.concurrent)
+                                 flap=flap, concurrent=args.concurrent,
+                                 topology=args.topology)
     if args.perfetto:
         from rocnrdma_tpu.telemetry.perfetto import export_trace
 
