@@ -1136,14 +1136,17 @@ class _PendingSync:
         self._done = False
 
     def _scatter(self, dtype_str: str, o: int, n: int,
-                 members: List[int], compress: bool, k: int) -> None:
+                 members: List[int], compress: bool, k: int,
+                 coll: int = 0) -> None:
         shim, jax, leaves, out = (self._shim, self._jax, self._leaves,
                                   self._out)
         buf = shim._staging[dtype_str]
         itemsize = np.dtype(dtype_str).itemsize
+        # coll = the bucket allreduce's collective trace id: the
+        # scatter bar joins its wire events in a merged fleet trace.
         with trace.span("xslice.bucket_scatter", seg=k,
                         lane=(k % 14) + 1, rank=shim.world.rank,
-                        bytes=n * itemsize):
+                        bytes=n * itemsize, coll=coll):
             if compress:
                 # Decompress the reduced bf16 wire bytes back into the
                 # f32 staging slice the scatter below reads.
@@ -1199,7 +1202,8 @@ class _PendingSync:
                     else:  # ("seg", handle, payload)
                         _, h, payload = op
                         h.wait()
-                        self._scatter(*payload)
+                        self._scatter(*payload,
+                                      coll=getattr(h, "coll", 0))
                 except BaseException:
                     # Drain everything still in flight and release the
                     # remaining adopted buffers, THEN re-raise the

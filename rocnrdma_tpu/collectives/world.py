@@ -58,6 +58,7 @@ elastic ladder holds per tier. See README "Hierarchical collectives".
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import struct
@@ -92,11 +93,14 @@ class CollectiveHandle:
     ``RingWorld.pending_async`` (the handle-leak census)."""
 
     def __init__(self, world: "RingWorld", op: RingOp, nbytes: int,
-                 what: str = "allreduce"):
+                 what: str = "allreduce", coll: int = 0):
         self._world = world
         self._op = op
         self._nbytes = nbytes
         self._what = what
+        # Collective trace id (the fleet-timeline join key); exposed
+        # so span emitters (jax_shim buckets) can label their bars.
+        self.coll = int(coll)
         self._t0 = time.monotonic()
         self._settled = False
 
@@ -122,6 +126,7 @@ class CollectiveHandle:
             self._settle()
             trace.event(f"world.{self._what}_done",
                         rank=self._world.rank, bytes=self._nbytes,
+                        coll=self.coll,
                         dur_s=time.monotonic() - self._t0)
         return ok
 
@@ -140,7 +145,7 @@ class CollectiveHandle:
             raise
         self._settle()
         trace.event(f"world.{self._what}_done", rank=self._world.rank,
-                    bytes=self._nbytes,
+                    bytes=self._nbytes, coll=self.coll,
                     dur_s=time.monotonic() - self._t0)
 
 class _PhasedHandle:
@@ -174,18 +179,35 @@ class _PhasedHandle:
         self._err: Optional[TransportError] = None
         self._raised = False
         flat = array.reshape(-1)
+        # One fleet-level collective id for the whole chain: each
+        # phase's submission seeds its tier/world sequence with it, so
+        # a merged trace shows one id across intra RS, delegate AR,
+        # and intra AG (attributable per tier by lane).
+        self.coll = world._next_coll()
+        coll = self.coll
+
+        def _seeded(w, fn):
+            def run():
+                w._seed_coll(coll)
+                return fn()
+            return run
+
         if hier:
             intra, inter = world._ensure_tiers()
             shard = flat[intra.owned_slice(flat)]
             self._pending = [
-                lambda: intra.reduce_scatter_async(flat, op),
-                lambda: inter.allreduce_async(shard, op, algo="flat"),
-                lambda: intra.all_gather_async(flat),
+                _seeded(intra,
+                        lambda: intra.reduce_scatter_async(flat, op)),
+                _seeded(inter,
+                        lambda: inter.allreduce_async(shard, op,
+                                                      algo="flat")),
+                _seeded(intra, lambda: intra.all_gather_async(flat)),
             ]
         else:
             self._pending = [
-                lambda: world.reduce_scatter_async(flat, op),
-                lambda: world.all_gather_async(flat),
+                _seeded(world,
+                        lambda: world.reduce_scatter_async(flat, op)),
+                _seeded(world, lambda: world.all_gather_async(flat)),
             ]
         # Phase 0 submits NOW — creation order is submission order.
         # Submission happens BEFORE this handle registers in the chain
@@ -202,7 +224,7 @@ class _PhasedHandle:
         world._async_live += 1
         trace.add("algo.hier" if hier else "algo.staged", 1)
         trace.event(f"world.{self._what}_async", rank=world.rank,
-                    bytes=self._nbytes)
+                    bytes=self._nbytes, coll=self.coll)
 
     @property
     def done(self) -> bool:
@@ -455,6 +477,20 @@ class RingWorld:
         # Tail of the phased-handle chain (per-ring submission-order
         # determinism for async hier/staged collectives).
         self._phased_tail = None
+        # ---- Fleet tracing (collective ids + postmortems) ----
+        # Per-world monotonic collective trace id: stamped on the
+        # ring before EVERY native collective (and wire-carried to the
+        # peer under FEAT_COLL_ID), so two ranks' flight-recorder
+        # events for one collective join by key in a merged timeline.
+        # Hier collectives seed all three tier phases with the parent
+        # id via _seed_coll. SPMD keeps the sequence identical across
+        # ranks — same collectives, same order.
+        self._coll_seq = 0
+        self._coll_override: Optional[int] = None
+        # Black-box postmortem bundles this world has written
+        # (TDR_POSTMORTEM_DIR; pushed via heartbeat so the coordinator
+        # serves tdr_postmortems_total{world=}).
+        self._postmortems = 0
         try:
             self._bootstrap(timeout_ms)
         except BaseException:
@@ -771,10 +807,36 @@ class RingWorld:
             return {name: {i: c for i, c in enumerate(buckets) if c}
                     for name, buckets in telemetry_histograms().items()}
 
+        def _trace_segment(max_events):
+            # collect_trace pull: one bounded flight-recorder window
+            # (destructive drain — flight-recorder semantics) plus the
+            # cumulative drop count so the merge can mark a truncated
+            # ring as tainted instead of silently under-reporting.
+            from rocnrdma_tpu import telemetry as tel
+            from rocnrdma_tpu.transport.engine import telemetry_dropped
+
+            if not tel.enabled():
+                return {"events": [], "dropped": 0, "disabled": True}
+            dropped = int(telemetry_dropped())
+            events = tel.timeline()
+            if len(events) > max_events:
+                # The truncation is a loss too: count it into the
+                # taint signal, or the merge would mark a visibly
+                # one-sided window as complete.
+                dropped += len(events) - max_events
+                events = events[-max_events:]
+            return {"events": tel.events_to_wire(events),
+                    "dropped": dropped}
+
+        def _postmortems():
+            w = wself()
+            return 0 if w is None else w._postmortems
+
         self._hb = self.controller.start_heartbeat(
             self.world_name, self.rank, state_fn=_state,
             interval_s=max(0.2, self._ctl_lease_ms / 3000.0),
-            counters_fn=_counters, hists_fn=_hists)
+            counters_fn=_counters, hists_fn=_hists,
+            trace_fn=_trace_segment, postmortems_fn=_postmortems)
 
     @property
     def control_stamp(self) -> str:
@@ -828,6 +890,37 @@ class RingWorld:
                 f"world torn down on rank {self.rank} (no live "
                 "incarnation); rebuild() required", retryable=True)
         return ring
+
+    # ------------------------------------------- collective trace ids
+
+    def _next_coll(self) -> int:
+        """The per-world monotonic collective trace id for the NEXT
+        collective: every rank runs the same collectives in the same
+        order (the SPMD contract), so the sequence is identical
+        fleet-wide and becomes the cross-rank join key. A parent
+        hierarchical collective seeds its tier phases with its own id
+        (_seed_coll), which this consumes one-shot."""
+        if self._coll_override is not None:
+            c, self._coll_override = self._coll_override, None
+            return c
+        self._coll_seq += 1
+        return self._coll_seq
+
+    def _seed_coll(self, coll: int) -> None:
+        """One-shot override for the next collective's trace id — how
+        a hier/staged parent makes its phase collectives (which run on
+        the TIER worlds with their own sequences) carry the parent's
+        id, so tdr_explain attributes all three phases to one
+        fleet-level collective, split per tier."""
+        self._coll_override = int(coll)
+
+    def _coll_ring(self) -> tuple:
+        """(live ring, fresh coll id) with the id already stamped on
+        the ring — the preamble of every collective entry point."""
+        ring = self._live_ring()
+        coll = self._next_coll()
+        ring.set_coll(coll)
+        return ring, coll
 
     # ------------------------------------------- hierarchical tiers
     #
@@ -972,17 +1065,21 @@ class RingWorld:
             self._hier_allreduce(array, op)
             return
         if algo == "staged":
+            ring, coll = self._coll_ring()
             with trace.span("world.allreduce", rank=self.rank,
-                            bytes=int(array.nbytes), algo="staged"):
+                            bytes=int(array.nbytes), algo="staged",
+                            coll=coll):
                 trace.add("algo.staged", 1)
-                ring = self._live_ring()
+                # One fleet-level collective, two phases: the sticky
+                # ring stamp carries the same id into the all_gather.
                 ring.reduce_scatter(array, op)
                 ring.all_gather(array)
             return
+        ring, coll = self._coll_ring()
         with trace.span("world.allreduce", rank=self.rank,
-                        bytes=int(array.nbytes)):
+                        bytes=int(array.nbytes), coll=coll):
             trace.add("algo.flat", 1)
-            self._live_ring().allreduce(array, op)
+            ring.allreduce(array, op)
 
     def _hier_allreduce(self, array, op: int = RED_SUM) -> None:
         """The two-tier schedule, blocking: every phase is the
@@ -991,13 +1088,21 @@ class RingWorld:
         code, not a re-derivation."""
         intra, inter = self._ensure_tiers()
         topo = self.topology
+        coll = self._next_coll()
         with trace.span("world.hier_allreduce", rank=self.rank,
                         bytes=int(array.nbytes), hosts=topo.n_hosts,
-                        local=topo.local_size):
+                        local=topo.local_size, coll=coll):
             trace.add("algo.hier", 1)
+            # All three tier phases carry the PARENT's trace id: one
+            # fleet-level collective, attributable per tier (the intra
+            # ring's events vs the delegate ring's) by the tier-world
+            # lanes they ride on.
+            intra._seed_coll(coll)
             own = intra.reduce_scatter(array, op)
             shard = array.reshape(-1)[own]
+            inter._seed_coll(coll)
             inter.allreduce(shard, op, algo="flat")
+            intra._seed_coll(coll)
             intra.all_gather(array)
 
     def allreduce_async(self, array, op: int = RED_SUM,
@@ -1023,13 +1128,13 @@ class RingWorld:
         algo = self._algo_for(int(array.nbytes), algo)
         if algo in ("hier", "staged"):
             return _PhasedHandle(self, array, op, hier=algo == "hier")
-        ring = self._live_ring()
+        ring, coll = self._coll_ring()
         trace.add("algo.flat", 1)
         trace.event("world.allreduce_async", rank=self.rank,
-                    bytes=int(array.nbytes))
+                    bytes=int(array.nbytes), coll=coll)
         rop = ring.allreduce_async(array, op)
         self._async_live += 1
-        return CollectiveHandle(self, rop, int(array.nbytes))
+        return CollectiveHandle(self, rop, int(array.nbytes), coll=coll)
 
     def reduce_scatter_async(self, array,
                              op: int = RED_SUM) -> "CollectiveHandle":
@@ -1038,24 +1143,24 @@ class RingWorld:
         results bitwise the blocking call's). Read the owned slice
         with :meth:`owned_slice` — it is a pure function of the
         layout, available before completion."""
-        ring = self._live_ring()
+        ring, coll = self._coll_ring()
         trace.event("world.reduce_scatter_async", rank=self.rank,
-                    bytes=int(array.nbytes))
+                    bytes=int(array.nbytes), coll=coll)
         rop = ring.reduce_scatter_async(array, op)
         self._async_live += 1
         return CollectiveHandle(self, rop, int(array.nbytes),
-                                what="reduce_scatter")
+                                what="reduce_scatter", coll=coll)
 
     def all_gather_async(self, array) -> "CollectiveHandle":
         """Nonblocking in-place all-gather of per-rank owned segments
         (the layout ``reduce_scatter`` leaves), on the async driver."""
-        ring = self._live_ring()
+        ring, coll = self._coll_ring()
         trace.event("world.all_gather_async", rank=self.rank,
-                    bytes=int(array.nbytes))
+                    bytes=int(array.nbytes), coll=coll)
         rop = ring.all_gather_async(array)
         self._async_live += 1
         return CollectiveHandle(self, rop, int(array.nbytes),
-                                what="all_gather")
+                                what="all_gather", coll=coll)
 
     def owned_slice(self, array) -> slice:
         """The flat-element slice this rank owns after a
@@ -1074,41 +1179,46 @@ class RingWorld:
         """In-place reduce-scatter; returns the element slice this
         rank owns afterwards (allreduce ≡ reduce_scatter then
         all_gather on the same buffer)."""
+        ring, coll = self._coll_ring()
         with trace.span("world.reduce_scatter", rank=self.rank,
-                        bytes=int(array.nbytes)):
-            return self._live_ring().reduce_scatter(array, op)
+                        bytes=int(array.nbytes), coll=coll):
+            return ring.reduce_scatter(array, op)
 
     def all_gather(self, array) -> None:
         """In-place all-gather of per-rank owned segments (the layout
         ``reduce_scatter`` leaves)."""
+        ring, coll = self._coll_ring()
         with trace.span("world.all_gather", rank=self.rank,
-                        bytes=int(array.nbytes)):
-            self._live_ring().all_gather(array)
+                        bytes=int(array.nbytes), coll=coll):
+            ring.all_gather(array)
 
     def broadcast(self, array, root: int = 0) -> None:
         """Broadcast root's buffer to every rank (store-and-forward
         chunk pipeline down the ring)."""
+        ring, coll = self._coll_ring()
         with trace.span("world.broadcast", rank=self.rank,
-                        bytes=int(array.nbytes)):
-            self._live_ring().broadcast(array, root)
+                        bytes=int(array.nbytes), coll=coll):
+            ring.broadcast(array, root)
 
     def all_to_all(self, array) -> None:
         """In-place all-to-all: the flat buffer is ``world`` equal
         segments, segment j FOR rank j on entry, FROM rank j on
         return (MPI_Alltoall; sequence<->head resharding's primitive,
         collectives/ulysses.py)."""
+        ring, coll = self._coll_ring()
         with trace.span("world.all_to_all", rank=self.rank,
-                        bytes=int(array.nbytes)):
-            self._live_ring().all_to_all(array)
+                        bytes=int(array.nbytes), coll=coll):
+            ring.all_to_all(array)
 
     def reduce(self, array, root: int = 0, op: int = RED_SUM) -> None:
         """Root-reduce: root's buffer ends holding the reduction over
         all ranks; non-root buffers are clobbered with the partials
         that passed through them (use allreduce when every rank needs
         the result intact)."""
+        ring, coll = self._coll_ring()
         with trace.span("world.reduce", rank=self.rank,
-                        bytes=int(array.nbytes)):
-            self._live_ring().reduce(array, root, op)
+                        bytes=int(array.nbytes), coll=coll):
+            ring.reduce(array, root, op)
 
     def set_seal_step(self, step: int) -> None:
         """Stamp the training step into outbound seals (informational
@@ -1139,6 +1249,10 @@ class RingWorld:
             ring.register_buffer(buf)
         else:
             buf[:] = 0
+        # Barriers are collectives too: a fresh id keeps the sticky
+        # ring stamp from attributing barrier frames to the previous
+        # data collective.
+        ring.set_coll(self._next_coll())
         ring.allreduce(buf)
 
     def _dg_hop(self, send_len: int, timeout: int, what: str) -> None:
@@ -1301,7 +1415,8 @@ class RingWorld:
     def rebuild(self, max_attempts: int = 6, backoff_s: float = 0.2,
                 backoff_cap_s: float = 5.0, jitter: float = 0.25,
                 timeout_ms: Optional[int] = None,
-                jitter_seed: Optional[int] = None) -> "RingWorld":
+                jitter_seed: Optional[int] = None,
+                reason: str = "") -> "RingWorld":
         """Tear down this incarnation and re-rendezvous under the next
         generation: exponential backoff with jitter between attempts,
         a bounded retry budget, and a per-attempt accept/connect
@@ -1323,10 +1438,23 @@ class RingWorld:
         Backoff jitter is drawn from a ``random.Random`` seeded with
         (``jitter_seed`` or TDR_REBUILD_SEED, rank, generation) —
         never the global ``random`` module — so a soak failure
-        replays exactly under the same ``TDR_FAULT_PLAN``."""
+        replays exactly under the same ``TDR_FAULT_PLAN``.
+
+        **Black-box postmortem**: with ``TDR_POSTMORTEM_DIR`` set,
+        every rebuild first dumps this rank's flight-recorder ring,
+        counter registry, last error (``reason``), and schedule digest
+        to ``<dir>/<world>/incident-g<generation>/rank<rank>.json`` —
+        keyed by the FAILED incarnation's generation, so all ranks of
+        one incident land in one directory and
+        ``tools/tdr_explain.py --postmortem`` merges them."""
         timeout = int(self.timeout_ms if timeout_ms is None else timeout_ms)
         note_fault_injections()
         note_integrity()
+        # Black-box postmortem BEFORE teardown: the flight recorder's
+        # recent past — the incident's evidence — is dumped while it
+        # still belongs to the failed incarnation (teardown appends
+        # flush noise and the next incarnation overwrites the ring).
+        self._write_postmortem(reason)
         self._teardown()
         arbitrated = self.controller is not None
         if arbitrated:
@@ -1372,6 +1500,59 @@ class RingWorld:
             f"world rebuild failed after {max_attempts} attempts (rank "
             f"{self.rank}, generation {self.generation}): {last}",
             retryable=False)
+
+    def _write_postmortem(self, reason: str = "") -> None:
+        """Dump the black-box bundle for a dying incarnation. Best
+        effort end to end — diagnostics must never take the recovery
+        ladder down — and a no-op without TDR_POSTMORTEM_DIR. The ring
+        drain is destructive (flight-recorder semantics: the incident
+        owns the recent past); counters/histograms are cumulative and
+        unaffected. In-process multi-rank harnesses share one native
+        ring, so bundles there interleave every co-located rank's
+        events — one process per rank (the production shape) gives
+        clean per-rank bundles."""
+        pm_dir = os.environ.get("TDR_POSTMORTEM_DIR")
+        if not pm_dir:
+            return
+        try:
+            from rocnrdma_tpu import telemetry as tel
+            from rocnrdma_tpu.transport.engine import telemetry_dropped
+
+            events = tel.timeline() if tel.enabled() else []
+            hb = self._hb
+            bundle = {
+                "format": "tdr-postmortem-v1",
+                "world": self.world_name,
+                "rank": self.rank,
+                "generation": self.generation,
+                "incarnation": self._ctl_inc,
+                "error": str(reason)[:400],
+                "wall_time": time.time(),
+                "monotonic_ns": time.monotonic_ns(),
+                "digest": self._sched_verified.hex(),
+                "seal_config": self.seal_config,
+                "coll_seq": self._coll_seq,
+                "counters": {k: int(v)
+                             for k, v in tel.counters().items()},
+                "dropped": int(telemetry_dropped()),
+                "clock_offset_ns": (hb.clock.offset_ns
+                                    if hb is not None else 0),
+                "events": tel.events_to_wire(events),
+            }
+            d = os.path.join(pm_dir, self.world_name,
+                             f"incident-g{self.generation}")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"rank{self.rank}.json")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(bundle, f)
+            os.replace(tmp, path)
+            self._postmortems += 1
+            trace.event("world.postmortem", rank=self.rank,
+                        generation=self.generation,
+                        events=len(bundle["events"]), path=path)
+        except Exception:
+            pass
 
     def _ctl_report_failure(self) -> None:
         """Tell the coordinator this incarnation failed. Best-effort:

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 from rocnrdma_tpu.utils.trace import trace
@@ -23,6 +24,56 @@ class ControlError(RuntimeError):
     """The coordinator was unreachable or spoke garbage (distinct from
     an ok=False arbitration answer, which is a protocol-level verdict
     the member must interpret)."""
+
+
+class ClockSync:
+    """NTP-style offset estimate against the coordinator's
+    CLOCK_MONOTONIC, min-RTT filtered.
+
+    Each heartbeat is a four-timestamp exchange: the member stamps t0
+    at send, the coordinator echoes its receive (t1) and send (t2)
+    instants, the member stamps t3 at the reply. Then
+
+        offset = ((t1 - t0) + (t2 - t3)) / 2   (coordinator - member)
+        rtt    = (t3 - t0) - (t2 - t1)
+
+    and |true_offset - offset| <= rtt / 2 — the asymmetry bound, so
+    the sample taken at the SMALLEST rtt carries the tightest bound.
+    The filter keeps exactly that sample (a new sample replaces the
+    estimate only when its rtt is <= the kept one's): the estimate's
+    error bound is monotonically non-increasing, and congestion
+    spikes — which inflate rtt and offset together — can never drag
+    the estimate around. Same-host ranks share the kernel clock, so
+    the estimate converges toward 0 there; the machinery is what makes
+    multi-host merges honest."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.offset_ns: int = 0
+        self.rtt_ns: Optional[int] = None  # None until the 1st sample
+        self.samples: int = 0
+
+    def sample(self, t0: int, t1: int, t2: int, t3: int) -> bool:
+        """Feed one exchange; True when it became the new estimate."""
+        rtt = (t3 - t0) - (t2 - t1)
+        if rtt < 0:  # clock misbehavior / garbled echo: discard
+            return False
+        offset = ((t1 - t0) + (t2 - t3)) // 2
+        with self._lock:
+            self.samples += 1
+            if self.rtt_ns is not None and rtt > self.rtt_ns:
+                return False
+            self.rtt_ns = rtt
+            self.offset_ns = offset
+            return True
+
+    def state(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "clock_offset_ns": int(self.offset_ns),
+                "clock_rtt_ns": int(self.rtt_ns or 0),
+                "clock_samples": int(self.samples),
+            }
 
 
 class ControlClient:
@@ -103,12 +154,29 @@ class ControlClient:
     def heartbeat(self, world: str, rank: int, incarnation: int,
                   generation: int,
                   counters: Optional[Dict[str, int]] = None,
-                  hists: Optional[Dict[str, Dict[int, int]]] = None
-                  ) -> Dict[str, Any]:
+                  hists: Optional[Dict[str, Dict[int, int]]] = None,
+                  **extra: Any) -> Dict[str, Any]:
+        """``extra`` carries the observability riders: ``t0_ns`` (the
+        clock-sync exchange), ``clock_offset_ns``/``clock_rtt_ns``
+        (the member's current min-RTT estimate, served on /metrics as
+        ``tdr_clock_offset_us``), and ``postmortems`` (bundles this
+        member has written, summed into
+        ``tdr_postmortems_total{world=}``)."""
         return self.request("heartbeat", timeout_s=15.0, world=world,
                             rank=int(rank), incarnation=int(incarnation),
                             generation=int(generation),
-                            counters=counters, hists=hists)
+                            counters=counters, hists=hists, **extra)
+
+    def collect_trace(self, world: str, timeout_s: float = 30.0,
+                      max_events: int = 65536) -> Dict[str, Any]:
+        """Pull one bounded flight-recorder segment from EVERY live
+        rank of ``world``: the coordinator flags the request, each
+        member's next heartbeat drains and pushes its segment, and the
+        call parks until all ranks reported (or the timeout). The
+        result's ``segments`` map feeds ``telemetry.merge_fleet`` and
+        ``tools/tdr_explain.py``."""
+        return self.request("collect_trace", timeout_s=timeout_s,
+                            world=world, max_events=int(max_events))
 
     def leave(self, world: str, rank: int,
               incarnation: int) -> Dict[str, Any]:
@@ -140,22 +208,28 @@ class ControlClient:
                         state_fn: Callable[[], tuple],
                         interval_s: float,
                         counters_fn: Optional[Callable[[], Dict]] = None,
-                        hists_fn: Optional[Callable[[], Dict]] = None
+                        hists_fn: Optional[Callable[[], Dict]] = None,
+                        trace_fn: Optional[Callable[[int], Dict]] = None,
+                        postmortems_fn: Optional[Callable[[], int]] = None
                         ) -> "Heartbeat":
         """Renew this member's lease from a daemon thread every
         ``interval_s``, pushing counter/histogram snapshots for the
         coordinator's /metrics aggregation. ``state_fn`` returns the
         member's CURRENT (incarnation, generation) — it changes across
-        rejoins, so the thread reads it per beat."""
+        rejoins, so the thread reads it per beat. ``trace_fn(max_events)``
+        serves ``collect_trace`` pulls (returns {"events": wire list,
+        "dropped": int}); ``postmortems_fn`` reports bundles written."""
         return Heartbeat(self, world, rank, state_fn, interval_s,
-                         counters_fn, hists_fn)
+                         counters_fn, hists_fn, trace_fn, postmortems_fn)
 
 
 class Heartbeat:
     def __init__(self, client: ControlClient, world: str, rank: int,
                  state_fn: Callable[[], tuple], interval_s: float,
                  counters_fn: Optional[Callable[[], Dict]] = None,
-                 hists_fn: Optional[Callable[[], Dict]] = None):
+                 hists_fn: Optional[Callable[[], Dict]] = None,
+                 trace_fn: Optional[Callable[[int], Dict]] = None,
+                 postmortems_fn: Optional[Callable[[], int]] = None):
         self._client = client
         self._world = world
         self._rank = rank
@@ -163,6 +237,17 @@ class Heartbeat:
         self._interval = max(0.05, float(interval_s))
         self._counters_fn = counters_fn
         self._hists_fn = hists_fn
+        self._trace_fn = trace_fn
+        self._postmortems_fn = postmortems_fn
+        # Clock-offset estimate vs the coordinator, fed by every beat
+        # and pushed back so /metrics serves tdr_clock_offset_us.
+        self.clock = ClockSync()
+        # collect_trace requests already answered (one push per id),
+        # and drained-but-unacknowledged payloads awaiting a retry —
+        # the ring drain is DESTRUCTIVE, so a failed push must resend
+        # the captured window, never re-drain an emptied ring.
+        self._pushed_traces: set = set()
+        self._trace_payloads: Dict[int, Dict[str, Any]] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"tdr-ctl-hb-{world}-{rank}")
@@ -182,13 +267,82 @@ class Heartbeat:
             return True  # between incarnations: nothing to renew
         counters = self._counters_fn() if self._counters_fn else None
         hists = self._hists_fn() if self._hists_fn else None
+        extra: Dict[str, Any] = self.clock.state()
+        if self._postmortems_fn is not None:
+            try:
+                extra["postmortems"] = int(self._postmortems_fn())
+            except Exception:
+                pass
+        t0 = time.monotonic_ns()
         resp = self._client.heartbeat(self._world, self._rank, inc, gen,
-                                      counters=counters, hists=hists)
+                                      counters=counters, hists=hists,
+                                      t0_ns=t0, **extra)
+        t3 = time.monotonic_ns()
+        try:
+            if int(resp.get("t0_ns", -1)) == t0:
+                self.clock.sample(t0, int(resp["t1_ns"]),
+                                  int(resp["t2_ns"]), t3)
+        except (KeyError, TypeError, ValueError):
+            pass  # pre-clock coordinator: estimate just stays at 0
         if not resp.get("ok"):
             trace.event("ctl.heartbeat_refused", world=self._world,
                         rank=self._rank,
                         error=str(resp.get("error", ""))[:80])
+            return True
+        collect = resp.get("collect")
+        if isinstance(collect, dict) and self._trace_fn is not None:
+            self._push_trace(collect, inc, gen)
         return True
+
+    def _push_trace(self, collect: Dict[str, Any], inc: int,
+                    gen: int) -> None:
+        """Serve one collect_trace pull: drain a bounded local segment
+        and push it under the request id. The drain runs ONCE per id
+        (it is destructive); the push retries on ANY failure —
+        transport loss or a coordinator refusal (e.g. this member was
+        superseded mid-push) — resending the CACHED window on the next
+        beat, because the flag stays up at the coordinator until this
+        rank's segment lands. Only success or a stale-id verdict (a
+        newer collect superseded the request) retires the id."""
+        try:
+            trace_id = int(collect.get("id", 0))
+            max_events = int(collect.get("max_events", 65536))
+        except (TypeError, ValueError):
+            return
+        if not trace_id or trace_id in self._pushed_traces:
+            return
+        payload = self._trace_payloads.get(trace_id)
+        if payload is None:
+            try:
+                seg = self._trace_fn(max_events) or {}
+            except Exception:
+                seg = {"events": [], "dropped": 0,
+                       "error": "trace_fn failed"}
+            payload = dict(seg)
+            payload.update(self.clock.state())
+            # Bound the retry cache: requests the coordinator timed
+            # out never re-flag, so their payloads would otherwise
+            # pin event windows forever.
+            while len(self._trace_payloads) >= 4:
+                self._trace_payloads.pop(
+                    min(self._trace_payloads), None)
+            self._trace_payloads[trace_id] = payload
+        try:
+            resp = self._client.request(
+                "trace_push", world=self._world, rank=self._rank,
+                incarnation=int(inc), generation=int(gen),
+                trace_id=trace_id, segment=payload)
+        except ControlError:
+            return  # payload stays cached; the next beat retries
+        if resp.get("ok") or resp.get("error") == "stale trace id":
+            self._pushed_traces.add(trace_id)
+            self._trace_payloads.pop(trace_id, None)
+            if resp.get("ok"):
+                trace.event("ctl.trace_push", world=self._world,
+                            rank=self._rank, trace_id=trace_id,
+                            events=len(payload.get("events") or []))
+        # Any other refusal (superseded member mid-rebuild): keep the
+        # cache, retry under the next incarnation's heartbeat.
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):

@@ -67,7 +67,8 @@ PROTOCOL_VERSION = 1
 class _Member:
     __slots__ = ("rank", "incarnation", "host", "host_key",
                  "lease_deadline", "alive", "waiting", "pending_view",
-                 "counters", "hists", "wait_token")
+                 "counters", "hists", "wait_token", "clock_offset_ns",
+                 "clock_rtt_ns", "postmortems")
 
     def __init__(self, rank: int, incarnation: int, host: str,
                  lease_deadline: float, host_key: Optional[str] = None):
@@ -94,12 +95,21 @@ class _Member:
         # is dead) stops waiting instead of racing the live retry for
         # the released view.
         self.wait_token = 0
+        # Heartbeat-pushed observability riders: the member's min-RTT
+        # clock-offset estimate vs this coordinator (what fleet trace
+        # merges align timestamps with; served as
+        # tdr_clock_offset_us{world=,rank=}) and the postmortem
+        # bundles it has written (summed into
+        # tdr_postmortems_total{world=}).
+        self.clock_offset_ns = 0
+        self.clock_rtt_ns = 0
+        self.postmortems = 0
 
 
 class _World:
     __slots__ = ("name", "size", "base_port", "qp_budget", "generation",
                  "epoch", "members", "ever_ready", "rebuilds",
-                 "lease_expiries", "joins")
+                 "lease_expiries", "joins", "trace_req", "trace_seq")
 
     def __init__(self, name: str, size: int, base_port: int,
                  qp_budget: int):
@@ -114,6 +124,11 @@ class _World:
         self.rebuilds = 0
         self.lease_expiries = 0
         self.joins = 0
+        # Pending collect_trace pull: {"id", "max_events", "segments":
+        # {rank: segment}} — heartbeats see the flag and push; the
+        # parked collector wakes when every live rank reported.
+        self.trace_req: Optional[Dict[str, Any]] = None
+        self.trace_seq = 0
 
     def alive_members(self) -> List[_Member]:
         return [m for m in self.members.values() if m.alive]
@@ -247,6 +262,8 @@ class Coordinator:
             "report": self._op_report,
             "heartbeat": self._op_heartbeat,
             "leave": self._op_leave,
+            "collect_trace": self._op_collect_trace,
+            "trace_push": self._op_trace_push,
         }.get(op)
         if handler is None:
             return {"ok": False, "error": f"unknown op: {op}"}
@@ -438,6 +455,11 @@ class Coordinator:
                     "rebuilds": w.rebuilds}
 
     def _op_heartbeat(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        # Clock-sync receive instant, stamped BEFORE the lock: the
+        # member's offset math treats t1 as "when the request reached
+        # the coordinator", and queueing on _cv is server processing
+        # time that belongs between t1 and t2, not before t1.
+        t1 = time.monotonic_ns()
         with self._cv:
             resolved, err = self._member(req)
             if err:
@@ -454,8 +476,108 @@ class Coordinator:
                     for name, buckets in hists.items()
                     if isinstance(buckets, dict)
                 }
-            return {"ok": True, "generation": w.generation,
+            # Observability riders: the member's current clock-offset
+            # estimate and postmortem tally (gauges on /metrics).
+            for attr, key in (("clock_offset_ns", "clock_offset_ns"),
+                              ("clock_rtt_ns", "clock_rtt_ns"),
+                              ("postmortems", "postmortems")):
+                v = req.get(key)
+                if v is not None:
+                    try:
+                        setattr(m, attr, int(v))
+                    except (TypeError, ValueError):
+                        pass
+            resp = {"ok": True, "generation": w.generation,
                     "stale": int(req.get("generation", -1)) != w.generation}
+            # Pending trace pull this member has not served yet: flag
+            # it so the member's heartbeat thread drains and pushes.
+            tr = w.trace_req
+            if tr is not None and m.rank not in tr["segments"]:
+                resp["collect"] = {"id": tr["id"],
+                                   "max_events": tr["max_events"]}
+            # Clock-sync echo: t0 back verbatim (the member matches it
+            # against the beat it stamped), our receive and send
+            # instants alongside.
+            t0 = req.get("t0_ns")
+            if t0 is not None:
+                resp["t0_ns"] = t0
+                resp["t1_ns"] = t1
+                resp["t2_ns"] = time.monotonic_ns()
+            return resp
+
+    def _op_trace_push(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """A member's answer to a collect flag: one bounded event
+        segment. Stored under the request id; the parked collector
+        wakes when every live rank has pushed."""
+        with self._cv:
+            resolved, err = self._member(req)
+            if err:
+                return err
+            w, m = resolved
+            tr = w.trace_req
+            if tr is None or int(req.get("trace_id", -1)) != tr["id"]:
+                return {"ok": False, "error": "stale trace id"}
+            seg = req.get("segment")
+            if not isinstance(seg, dict):
+                return {"ok": False, "error": "bad segment"}
+            seg = dict(seg)
+            seg["rank"] = m.rank
+            seg["incarnation"] = m.incarnation
+            tr["segments"][m.rank] = seg
+            trace.event("ctl.trace_push", world=w.name, rank=m.rank,
+                        trace_id=tr["id"],
+                        events=len(seg.get("events") or []))
+            self._cv.notify_all()
+            return {"ok": True}
+
+    def _op_collect_trace(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """Operator op: pull one flight-recorder segment from every
+        live rank of a world. Parks until all ranks pushed or the
+        caller's budget expires; a timeout returns ok=False WITH
+        whatever arrived (partial visibility beats none during an
+        incident)."""
+        name = req.get("world")
+        timeout_s = min(max(float(req.get("timeout_s", 30.0)), 0.0), 600.0)
+        max_events = max(1, min(int(req.get("max_events", 65536)),
+                                1 << 20))
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            w = self._worlds.get(name)
+            if w is None:
+                return {"ok": False, "error": "unknown world"}
+            if w.trace_req is not None:
+                return {"ok": False,
+                        "error": "trace collection already in progress"}
+            w.trace_seq += 1
+            tr = {"id": w.trace_seq, "max_events": max_events,
+                  "segments": {}}
+            w.trace_req = tr
+            trace.event("ctl.collect_trace", world=w.name,
+                        trace_id=tr["id"], max_events=max_events)
+            try:
+                while True:
+                    alive = {m.rank for m in w.alive_members()}
+                    if alive and alive <= set(tr["segments"]):
+                        break
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        return {
+                            "ok": False, "error": "collect timeout",
+                            "generation": w.generation,
+                            "world_size": w.size,
+                            "segments": {str(r): s for r, s in
+                                         sorted(tr["segments"].items())},
+                        }
+                    self._cv.wait(min(left, 0.25))
+            finally:
+                w.trace_req = None
+            return {
+                "ok": True,
+                "generation": w.generation,
+                "world_size": w.size,
+                "segments": {str(r): s for r, s in
+                             sorted(tr["segments"].items())},
+            }
 
     def _op_leave(self, req: Dict[str, Any]) -> Dict[str, Any]:
         with self._cv:
@@ -508,7 +630,15 @@ class Coordinator:
         the per-world aggregate (``{world=}``, label shape unchanged)
         and per member (``{world=,rank=}``) — and the histogram
         quantile series ``tdr_<hist>{...,quantile="0.99"}`` (e.g.
-        ``tdr_chunk_lat_us``)."""
+        ``tdr_chunk_lat_us``). Fleet-tracing additions (also
+        contract-pinned): ``tdr_postmortems_total{world=}`` (black-box
+        bundles written across the world) and
+        ``tdr_clock_offset_us{world=,rank=}`` /
+        ``tdr_clock_rtt_us{world=,rank=}`` (each member's min-RTT
+        clock estimate vs this coordinator); note
+        ``tdr_telemetry_dropped_total{world=,rank=}`` already rides
+        the registry family — a nonzero value taints event-derived
+        fractions for that rank's windows."""
         with self._lock:
             lines = [
                 f"# tdr coordinator metrics v{PROTOCOL_VERSION}",
@@ -532,7 +662,22 @@ class Coordinator:
                     f"tdr_ctl_lease_expiries_total{lab} "
                     f"{w.lease_expiries}",
                     f"tdr_ctl_joins_total{lab} {w.joins}",
+                    # Black-box postmortems written across the world's
+                    # slots (heartbeat-pushed; slots keep serving their
+                    # current occupant's tally like every other series).
+                    f"tdr_postmortems_total{lab} "
+                    f"{sum(m.postmortems for m in w.members.values())}",
                 ]
+                # Per-member clock offsets vs this coordinator (µs;
+                # min-RTT filtered on the member side) — the numbers a
+                # fleet trace merge aligns timestamps with, and the
+                # live skew readout tdr_top --connect renders.
+                for m in sorted(w.members.values(), key=lambda m: m.rank):
+                    rlab = f'{{world="{name}",rank="{m.rank}"}}'
+                    lines.append(f"tdr_clock_offset_us{rlab} "
+                                 f"{m.clock_offset_ns / 1000.0:.6g}")
+                    lines.append(f"tdr_clock_rtt_us{rlab} "
+                                 f"{m.clock_rtt_ns / 1000.0:.6g}")
                 # Member-pushed counter registry, summed over each
                 # slot's CURRENT occupant — dead or departed members
                 # keep serving their last snapshot (a scraper must not

@@ -187,6 +187,15 @@ int tdr_qp_has_seal(tdr_qp *qp);
  * set TDR_SEAL_CMA=1 (FEAT_SEAL_CMA_FULL). */
 int tdr_qp_has_seal_payload(tdr_qp *qp);
 
+/* Whether this QP negotiated FEAT_COLL_ID (emu only): frames carry
+ * the posting rank's collective trace id in an 8-byte header
+ * extension, so the receiver's telemetry events tag with the SAME id
+ * the sender stamped (retransmits keep it; tag-only CMA seals carry
+ * it too). Advertised only when TDR_TELEMETRY was on at handshake
+ * time — with the feature off the wire format is byte-identical to
+ * the pre-trace-id framing. */
+int tdr_qp_has_coll_id(tdr_qp *qp);
+
 /* ------------------------------------------------------------------ *
  * Flight recorder — the engine-side telemetry subsystem.
  *
@@ -275,6 +284,14 @@ typedef struct {
   uint32_t qp;     /* qp track id (tdr_tel_qp_id), 0 = none */
   uint64_t id;     /* wr_id / frame seq / call seq */
   uint64_t arg;    /* bytes / status / attempt (per type) */
+  /* Collective trace id (0 = none): the per-world monotonically
+   * increasing id of the collective this event belongs to, stamped by
+   * the posting rank (tdr_ring_set_coll) and CARRIED IN THE FRAME
+   * HEADER to the peer when both ends negotiated FEAT_COLL_ID — so
+   * two ranks' wire_rx/land/verify/fold/wc events for one collective
+   * join by key across a merged fleet timeline. Ids with bit 63 set
+   * were auto-assigned by the ring (caller never set one). */
+  uint64_t coll;
 } tdr_tel_event;
 
 int tdr_tel_enabled(void);
@@ -492,6 +509,16 @@ int tdr_ring_channels(const tdr_ring *r);
  * built-in default): the value schedule digests must hash — the raw
  * env string hides a changed built-in default from the digest. */
 size_t tdr_ring_chunk_bytes(void);
+/* Stamp the collective trace id for the NEXT collective on this ring
+ * (blocking call or async start): the id lands in every telemetry
+ * event of that collective and — when FEAT_COLL_ID is negotiated —
+ * rides the frame header to the peer. Sticky until replaced; the
+ * caller (the world layer) sets a fresh per-world monotonic id before
+ * every collective. Rings whose caller never sets one auto-assign
+ * ids with bit 63 set, so caller-assigned and auto ids never
+ * collide. Purely observational: never negotiated, never part of the
+ * schedule digest, and results are unaffected. */
+void tdr_ring_set_coll(tdr_ring *r, uint64_t coll_id);
 int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
                        int red_op);
 /* The rest of the MPI-app collective surface, sharing the

@@ -69,6 +69,10 @@ int tdr_qp_has_seal_payload(tdr_qp *qp) {
   return reinterpret_cast<Qp *>(qp)->has_seal_payload() ? 1 : 0;
 }
 
+int tdr_qp_has_coll_id(tdr_qp *qp) {
+  return reinterpret_cast<Qp *>(qp)->has_coll_id() ? 1 : 0;
+}
+
 tdr_engine *tdr_engine_open(const char *spec) {
   std::string s = spec ? spec : "auto";
   std::string err;

@@ -40,8 +40,10 @@ inline bool tel_on() {
   return s == 2;
 }
 uint64_t tel_now_ns();
+// coll = collective trace id (0 = none): posting sites pass the
+// ring-stamped id; landing sites pass the frame-carried one.
 void tel_emit(uint16_t type, uint16_t engine, uint32_t qp, uint64_t id,
-              uint64_t arg);
+              uint64_t arg, uint64_t coll = 0);
 void tel_hist_add(int which, uint64_t value);
 uint16_t tel_next_engine_id();
 uint32_t tel_next_qp_id();
@@ -55,6 +57,13 @@ uint32_t tel_thread_track();
 #define TDR_TEL(type, eng, qp, id, arg)                                  \
   do {                                                                   \
     if (tdr::tel_on()) tdr::tel_emit((type), (eng), (qp), (id), (arg));  \
+  } while (0)
+
+// Collective-tagged variant (same one-branch guard).
+#define TDR_TELC(type, eng, qp, id, arg, coll)                           \
+  do {                                                                   \
+    if (tdr::tel_on())                                                   \
+      tdr::tel_emit((type), (eng), (qp), (id), (arg), (coll));           \
   } while (0)
 
 class Engine;
@@ -90,6 +99,13 @@ class Qp {
   // bring-up; the engine must outlive its QPs, which the close
   // discipline — QPs first, engine last — already requires).
   Engine *owner = nullptr;
+  // Collective trace id of the collective currently posting on this
+  // QP (0 = none): stamped by the ring layer at collective entry, read
+  // by the posting-path event sites and — when FEAT_COLL_ID is
+  // negotiated — written into outbound frame headers. Purely
+  // observational; a stale value mislabels a telemetry event, never
+  // a result.
+  std::atomic<uint64_t> cur_coll{0};
   virtual int post_write(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                          size_t len, uint64_t wr_id) = 0;
   virtual int post_read(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
@@ -142,6 +158,10 @@ class Qp {
   // advertised FEAT_SEAL_CMA_FULL (the tag/steering fields are always
   // covered on sealed connections).
   virtual bool has_seal_payload() const { return has_seal(); }
+  // Whether FEAT_COLL_ID was negotiated (frames carry the collective
+  // trace id to the peer; emu only, and only when both ends were
+  // recording at handshake time).
+  virtual bool has_coll_id() const { return false; }
   virtual int poll(tdr_wc *wc, int max, int timeout_ms) = 0;
   virtual int close_qp() = 0;
 };
@@ -261,6 +281,13 @@ enum : uint32_t {
   // trailer CRC covers, so a unilateral switch would fail every
   // verification). The TCP stream tier always seals the payload.
   FEAT_SEAL_CMA_FULL = 1u << 3,
+  // Collective trace ids on the wire: frames carry the posting rank's
+  // coll id in an 8-byte header extension so the peer's telemetry
+  // events join the sender's by key. Frame-changing, so negotiated;
+  // advertised only when TDR_TELEMETRY was on at handshake time —
+  // with the feature off, frames are byte-identical to the
+  // pre-trace-id wire format (acceptance-pinned).
+  FEAT_COLL_ID = 1u << 4,
 };
 
 // Locally-willing feature set (TDR_NO_FOLDBACK / TDR_NO_FUSED2 act

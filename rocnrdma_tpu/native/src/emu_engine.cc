@@ -114,9 +114,19 @@ struct FrameHdr {
   uint64_t raddr;
   uint64_t len;
   uint64_t aux;  // desc mode: source (WRITE/SEND) or dest (READ) VA
+  // Collective trace id (FEAT_COLL_ID extension). On the wire ONLY
+  // when both ends negotiated the feature (telemetry on at handshake
+  // on both ranks) — connections without it send/read exactly the
+  // first kFrameHdrWireBase bytes, byte-identical to the
+  // pre-trace-id framing. Retransmissions rebuild the header from the
+  // pending op, which keeps the original id. Deliberately not
+  // CRC-covered: a flipped id mislabels a telemetry event, never a
+  // landing.
+  uint64_t coll;
 };
 #pragma pack(pop)
-static_assert(sizeof(FrameHdr) == 40, "wire format");
+static_assert(sizeof(FrameHdr) == 48, "wire format");
+constexpr size_t kFrameHdrWireBase = 40;  // bytes without FEAT_COLL_ID
 
 // Seal CRC material: payload bytes, the trailer tag (gen/step/cseq),
 // then the header fields that STEER the landing (len, raddr) — a
@@ -503,6 +513,10 @@ struct PendingOp {
   // Flight recorder: post timestamp feeding the post→completion
   // latency histogram. 0 when telemetry is off (no clock read).
   uint64_t post_ns = 0;
+  // Collective trace id at post time (0 = none): retransmissions and
+  // the completion's WC event keep reporting the ORIGINAL collective
+  // whatever the QP's cur_coll has advanced to.
+  uint64_t coll = 0;
 };
 
 // RAII pair for EmuEngine::landing_begin: guarantees the inflight ref
@@ -533,6 +547,8 @@ struct PostedRecv {
   uint64_t ticket = 0;
   // Flight recorder: post timestamp (0 = telemetry off at post time).
   uint64_t post_ns = 0;
+  // Collective trace id at post time (0 = none).
+  uint64_t coll = 0;
 };
 
 bool EmuMr::quiesce_wait() {
@@ -575,9 +591,11 @@ class EmuQp : public Qp {
   }
 
   // Flight-recorder event bound to this QP's (engine, qp) tracks —
-  // one predicted branch when TDR_TELEMETRY is off.
-  void tel(uint16_t type, uint64_t id, uint64_t arg) {
-    TDR_TEL(type, eng_->tel_id, tel_id, id, arg);
+  // one predicted branch when TDR_TELEMETRY is off. `coll` tags the
+  // event with its collective trace id: posting sites pass the
+  // ring-stamped cur_coll, landing sites the frame-carried id.
+  void tel(uint16_t type, uint64_t id, uint64_t arg, uint64_t coll = 0) {
+    TDR_TELC(type, eng_->tel_id, tel_id, id, arg, coll);
   }
 
   // Completion accounting: the WC event plus the post→completion
@@ -585,10 +603,11 @@ class EmuQp : public Qp {
   // both: errored lengths are not traffic, and a flushed WR's
   // "latency" is the stall-until-teardown duration — recording it
   // would let one fault run poison the p99 the bench record diffs.
-  void tel_wc(uint64_t wr_id, int status, uint64_t len, uint64_t post_ns) {
+  void tel_wc(uint64_t wr_id, int status, uint64_t len, uint64_t post_ns,
+              uint64_t coll = 0) {
     if (!tel_on()) return;
     tel_emit(TDR_TEL_WC, eng_->tel_id, tel_id, wr_id,
-             static_cast<uint64_t>(status));
+             static_cast<uint64_t>(status), coll);
     if (status != TDR_WC_SUCCESS) return;
     if (post_ns)
       tel_hist_add(TDR_HIST_CHUNK_LAT_US, (tel_now_ns() - post_ns) / 1000);
@@ -616,7 +635,8 @@ class EmuQp : public Qp {
 
   int post_write(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                  size_t len, uint64_t wr_id) override {
-    tel(TDR_TEL_POST_WRITE, wr_id, len);
+    uint64_t coll = cur_coll.load(std::memory_order_relaxed);
+    tel(TDR_TEL_POST_WRITE, wr_id, len, coll);
     fault_post(nullptr, TDR_OP_WRITE, wr_id);
     char *src = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
@@ -637,8 +657,9 @@ class EmuQp : public Qp {
     h.raddr = raddr;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(src);
+    h.coll = coll;
     h.seq = new_pending(wr_id, TDR_OP_WRITE, nullptr, len, emr, h.op, src,
-                        raddr, rkey);
+                        raddr, rkey, coll);
     if (!send_frame_sealed(h, src, len, cma_, wr_id))
       return fail_pending(h.seq);
     return 0;
@@ -646,7 +667,8 @@ class EmuQp : public Qp {
 
   int post_read(Mr *lmr, size_t loff, uint64_t raddr, uint32_t rkey,
                 size_t len, uint64_t wr_id) override {
-    tel(TDR_TEL_POST_READ, wr_id, len);
+    uint64_t coll = cur_coll.load(std::memory_order_relaxed);
+    tel(TDR_TEL_POST_READ, wr_id, len, coll);
     fault_post(nullptr, TDR_OP_READ, wr_id);
     char *dst = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
@@ -667,13 +689,16 @@ class EmuQp : public Qp {
     h.raddr = raddr;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(dst);
-    h.seq = new_pending(wr_id, TDR_OP_READ, dst, len, emr);
+    h.coll = coll;
+    h.seq = new_pending(wr_id, TDR_OP_READ, dst, len, emr, 0, nullptr, 0, 0,
+                        coll);
     if (!send_frame(h, nullptr, 0)) return fail_pending(h.seq);
     return 0;
   }
 
   int post_send(Mr *lmr, size_t loff, size_t len, uint64_t wr_id) override {
-    tel(TDR_TEL_POST_SEND, wr_id, len);
+    uint64_t coll = cur_coll.load(std::memory_order_relaxed);
+    tel(TDR_TEL_POST_SEND, wr_id, len, coll);
     if (fault_post("send", TDR_OP_SEND, wr_id)) return 0;
     char *src = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
@@ -692,8 +717,9 @@ class EmuQp : public Qp {
     h.op = cma_ ? OP_SEND_DESC : OP_SEND;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(src);
+    h.coll = coll;
     h.seq = new_pending(wr_id, TDR_OP_SEND, nullptr, len, emr, h.op, src,
-                        0, 0);
+                        0, 0, coll);
     if (!send_frame_sealed(h, src, len, cma_, wr_id))
       return fail_pending(h.seq);
     return 0;
@@ -716,7 +742,8 @@ class EmuQp : public Qp {
       set_error("post_send_foldback: not negotiated with peer");
       return -1;
     }
-    tel(TDR_TEL_POST_SEND, wr_id, len);
+    uint64_t coll = cur_coll.load(std::memory_order_relaxed);
+    tel(TDR_TEL_POST_SEND, wr_id, len, coll);
     if (fault_post("send", TDR_OP_SEND, wr_id)) return 0;
     char *src = eng_->local_ptr(lmr, loff, len);
     auto *emr = static_cast<EmuMr *>(lmr);
@@ -735,12 +762,14 @@ class EmuQp : public Qp {
     h.op = cma_ ? OP_SEND_FB_DESC : OP_SEND_FB;
     h.len = len;
     h.aux = reinterpret_cast<uint64_t>(src);
+    h.coll = coll;
     // dst = src: the folded result lands back over the source region.
     // Stream tier: the ack payload is read into it (landing
     // re-validated at the ack handler); CMA tier: the receiver's
     // fused kernel writes it directly before acking, made safe by the
     // active inflight ref this post holds until completion.
-    h.seq = new_pending(wr_id, TDR_OP_SEND, src, len, emr, h.op, src, 0, 0);
+    h.seq = new_pending(wr_id, TDR_OP_SEND, src, len, emr, h.op, src, 0, 0,
+                        coll);
     if (!send_frame_sealed(h, src, len, cma_, wr_id))
       return fail_pending(h.seq);
     return 0;
@@ -784,6 +813,8 @@ class EmuQp : public Qp {
 
   bool has_seal_payload() const override { return seal_payload_; }
 
+  bool has_coll_id() const override { return coll_wire_; }
+
   int poll(tdr_wc *wc, int max, int timeout_ms) override {
     std::unique_lock<std::mutex> lk(mu_);
     if (cq_.empty() && timeout_ms != 0) {
@@ -824,6 +855,8 @@ class EmuQp : public Qp {
     uint64_t seq = 0;
     uint64_t src_va = 0;
     uint64_t len = 0;
+    // Frame-carried collective trace id (0 = none / not negotiated).
+    uint64_t coll = 0;
     // Sealed connections: the message arrived corrupt with no recv
     // posted. The entry holds the message's POSITION in the FIFO (so
     // later messages keep matching later recvs) while its payload
@@ -851,7 +884,9 @@ class EmuQp : public Qp {
   int queue_recv(PostedRecv r) {
     if (tel_on()) {
       r.post_ns = tel_now_ns();
-      tel_emit(TDR_TEL_POST_RECV, eng_->tel_id, tel_id, r.wr_id, r.maxlen);
+      r.coll = cur_coll.load(std::memory_order_relaxed);
+      tel_emit(TDR_TEL_POST_RECV, eng_->tel_id, tel_id, r.wr_id, r.maxlen,
+               r.coll);
     }
     std::unique_lock<std::mutex> lk(mu_);
     r.ticket = recv_head_++;
@@ -925,7 +960,7 @@ class EmuQp : public Qp {
       // final.
       bool ok = par_cma_reduce2(peer_pid_, r.dst, u.src_va, u.len, r.dtype,
                                 r.red_op);
-      if (ok) tel(TDR_TEL_FOLD, u.seq, u.len);
+      if (ok) tel(TDR_TEL_FOLD, u.seq, u.len, u.coll ? u.coll : r.coll);
       ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
       sent = send_frame(ack, nullptr, 0);
       complete_recv(r,
@@ -939,9 +974,10 @@ class EmuQp : public Qp {
     // landing path (par_reduce, par_cma_reduce_from) uses the copy pool.
     par_reduce2_local(r.dst, u.payload.data(),
                       u.len / dtype_size(r.dtype), r.dtype, r.red_op);
-    tel(TDR_TEL_FOLD, u.seq, u.len);
+    tel(TDR_TEL_FOLD, u.seq, u.len, u.coll ? u.coll : r.coll);
     ack.status = TDR_WC_SUCCESS;
     ack.len = u.len;
+    ack.coll = u.coll;
     sent = send_frame(ack, u.payload.data(), u.payload.size());
     complete_recv(r, {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
     return sent;
@@ -972,9 +1008,10 @@ class EmuQp : public Qp {
     }
     par_reduce2_local(r.dst, u.payload.data(),
                       u.len / dtype_size(r.dtype), r.dtype, r.red_op);
-    tel(TDR_TEL_FOLD, u.seq, u.len);
+    tel(TDR_TEL_FOLD, u.seq, u.len, u.coll ? u.coll : r.coll);
     ack.status = TDR_WC_SUCCESS;
     ack.len = u.len;
+    ack.coll = u.coll;
     SealTrailer t{};
     t.gen = static_cast<uint32_t>(eng_->seal_gen());
     t.step = static_cast<uint32_t>(eng_->seal_step());
@@ -1014,7 +1051,7 @@ class EmuQp : public Qp {
         t.gen != static_cast<uint32_t>(local))
       ok = false;
     seal_count(ok ? kSealVerified : kSealFailed);
-    tel(ok ? TDR_TEL_VERIFY_OK : TDR_TEL_VERIFY_FAIL, h.seq, len);
+    tel(ok ? TDR_TEL_VERIFY_OK : TDR_TEL_VERIFY_FAIL, h.seq, len, h.coll);
     *ok_out = ok;
     return true;
   }
@@ -1034,10 +1071,10 @@ class EmuQp : public Qp {
       return {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, len};
     DmaGuard guard{r.mr};
     (void)guard;
-    tel(TDR_TEL_LAND, r.wr_id, len);
+    tel(TDR_TEL_LAND, r.wr_id, len, r.coll);
     if (r.is_reduce) {
       par_reduce(r.dst, data, len / dtype_size(r.dtype), r.dtype, r.red_op);
-      tel(TDR_TEL_FOLD, r.wr_id, len);
+      tel(TDR_TEL_FOLD, r.wr_id, len, r.coll);
     } else {
       par_memcpy(r.dst, data, len);
     }
@@ -1059,7 +1096,7 @@ class EmuQp : public Qp {
     }
     DmaGuard guard{r.mr};
     (void)guard;
-    tel(TDR_TEL_LAND, r.wr_id, len);
+    tel(TDR_TEL_LAND, r.wr_id, len, r.coll);
     if (!r.is_reduce) {
       if (!read_full(fd_, r.dst, len)) return false;
     } else {
@@ -1075,7 +1112,7 @@ class EmuQp : public Qp {
         dst += chunk;
         left -= chunk;
       }
-      tel(TDR_TEL_FOLD, r.wr_id, len);
+      tel(TDR_TEL_FOLD, r.wr_id, len, r.coll);
     }
     *wc = {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, len};
     return true;
@@ -1096,13 +1133,13 @@ class EmuQp : public Qp {
     }
     DmaGuard guard{r.mr};
     (void)guard;
-    tel(TDR_TEL_LAND, r.wr_id, len);
+    tel(TDR_TEL_LAND, r.wr_id, len, r.coll);
     bool ok;
     if (!r.is_reduce) {
       ok = par_cma_copy_from(peer_pid_, r.dst, src, len);
     } else {
       ok = par_cma_reduce_from(peer_pid_, r.dst, src, len, r.dtype, r.red_op);
-      if (ok) tel(TDR_TEL_FOLD, r.wr_id, len);
+      if (ok) tel(TDR_TEL_FOLD, r.wr_id, len, r.coll);
     }
     *wc = {r.wr_id, ok ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
            TDR_OP_RECV, len};
@@ -1149,6 +1186,10 @@ class EmuQp : public Qp {
     // a mismatched pair degrades to plain frames, never misparses).
     seal_ = (features_ & FEAT_SEAL) != 0;
     seal_budget_ = seal_retry_budget();
+    // Wire-carried collective trace ids: both ends were recording at
+    // handshake time, so every frame header grows the 8-byte id word
+    // (send_frame/progress_loop agree on the length per connection).
+    coll_wire_ = (features_ & FEAT_COLL_ID) != 0;
     // seal_payload_ is resolved AFTER the CMA probe below: whether the
     // trailer CRC covers the payload depends on the negotiated tier.
 
@@ -1207,8 +1248,9 @@ class EmuQp : public Qp {
   uint64_t new_pending(uint64_t wr_id, int opcode, char *dst, uint64_t len,
                        EmuMr *mr, uint8_t wire_op = 0,
                        const char *src = nullptr, uint64_t raddr = 0,
-                       uint32_t rkey = 0) {
-    PendingOp p{wr_id, opcode, dst, len, mr, wire_op, src, raddr, rkey, 0};
+                       uint32_t rkey = 0, uint64_t coll = 0) {
+    PendingOp p{wr_id, opcode, dst, len, mr, wire_op, src, raddr, rkey, 0,
+                coll};
     if (tel_on()) p.post_ns = tel_now_ns();
     std::lock_guard<std::mutex> g(mu_);
     uint64_t seq = next_seq_++;
@@ -1224,13 +1266,14 @@ class EmuQp : public Qp {
     if (it != pending_.end()) {
       tdr_wc wc{it->second.wr_id, TDR_WC_FLUSH_ERR, it->second.opcode, 0};
       uint64_t post_ns = it->second.post_ns;
+      uint64_t coll = it->second.coll;
       cq_.push_back(wc);
       release_pending_mr(it->second.mr);
       pending_.erase(it);
       cv_.notify_all();
       lk.unlock();
       eng_->cq_pulse();
-      tel_wc(wc.wr_id, wc.status, 0, post_ns);
+      tel_wc(wc.wr_id, wc.status, 0, post_ns, coll);
     }
     set_error("post: connection down");
     return -1;
@@ -1238,11 +1281,15 @@ class EmuQp : public Qp {
 
   bool send_frame(const FrameHdr &h, const void *payload, size_t len,
                   const SealTrailer *trailer = nullptr) {
+    // Header wire length is fixed per CONNECTION at handshake time
+    // (FEAT_COLL_ID appends the trace-id word); both ends agreed, so
+    // the parser can never misframe.
+    size_t hb = coll_wire_ ? sizeof(FrameHdr) : kFrameHdrWireBase;
     std::lock_guard<std::mutex> g(send_mu_);
     if (payload && len) {
-      if (!write_hdr_payload(fd_, &h, sizeof(h), payload, len)) return false;
+      if (!write_hdr_payload(fd_, &h, hb, payload, len)) return false;
     } else {
-      if (!write_full(fd_, &h, sizeof(h))) return false;
+      if (!write_full(fd_, &h, hb)) return false;
     }
     if (trailer) return write_full(fd_, trailer, sizeof(*trailer));
     return true;
@@ -1258,7 +1305,7 @@ class EmuQp : public Qp {
   // corruption flips the CRC instead.
   bool send_frame_sealed(FrameHdr h, const char *src, size_t len, bool desc,
                          uint64_t wr_id) {
-    tel(TDR_TEL_WIRE_TX, h.seq, len);
+    tel(TDR_TEL_WIRE_TX, h.seq, len, h.coll);
     if (!seal_)
       return desc ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
     SealTrailer t{};
@@ -1290,11 +1337,14 @@ class EmuQp : public Qp {
   // stuck in a NAK/retransmit cycle holds back the delivery (not the
   // landing) of later chunks' completions, preserving the FIFO
   // completion order the ring schedules assert.
-  void complete_recv(const PostedRecv &r, tdr_wc wc) {
+  void complete_recv(const PostedRecv &r, tdr_wc wc, uint64_t coll = 0) {
     // The WC event fires when the completion is RECORDED; CQ delivery
     // may still be withheld behind an earlier ticket (posted-order
     // contract) — the timeline shows the truth, not the FIFO.
-    tel_wc(wc.wr_id, wc.status, wc.len, r.post_ns);
+    // `coll` is the landed frame's trace id when the caller has it;
+    // the posted recv's own id is the fallback (SPMD keeps them equal
+    // except across skewed collective boundaries).
+    tel_wc(wc.wr_id, wc.status, wc.len, r.post_ns, coll ? coll : r.coll);
     {
       std::lock_guard<std::mutex> g(mu_);
       recv_done_[r.ticket] = wc;
@@ -1366,7 +1416,7 @@ class EmuQp : public Qp {
       }
       release_recv(r);
       bool sent = send_frame(ack, nullptr, 0);
-      complete_recv(r, wc);
+      complete_recv(r, wc, h.coll);
       return sent;
     }
     // Unexpected message: materialize it now. In desc mode the
@@ -1400,16 +1450,19 @@ class EmuQp : public Qp {
         Unexpected u;
         u.payload = std::move(buf);
         u.len = h.len;
+        u.coll = h.coll;
         unexpected_.push_back(std::move(u));
       }
     }
     if (have2) {
       if (ok)
         complete_recv(r2,
-                      deliver_buffer_wc(r2, buf.data(), buf.size()));
+                      deliver_buffer_wc(r2, buf.data(), buf.size()),
+                      h.coll);
       else
         complete_recv(r2,
-                      {r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
+                      {r2.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len},
+                      h.coll);
       release_recv(r2);
     }
     return sent;
@@ -1430,6 +1483,7 @@ class EmuQp : public Qp {
     u.seq = h.seq;
     u.src_va = h.aux;
     u.len = h.len;
+    u.coll = h.coll;
     if (!desc) {
       // Materialize the stream payload up front (it is consumed from
       // the socket either way; a doomed fold still must drain it).
@@ -1486,7 +1540,8 @@ class EmuQp : public Qp {
       }
       bool sent = send_frame(ack, nullptr, 0);
       complete_recv(r,
-                    {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len});
+                    {r.wr_id, TDR_WC_LOC_ACCESS_ERR, TDR_OP_RECV, h.len},
+                    h.coll);
       release_recv(r);
       return sent;
     }
@@ -1498,7 +1553,7 @@ class EmuQp : public Qp {
       // verification read of r.dst.
       DmaGuard guard{r.mr};
       (void)guard;
-      tel(TDR_TEL_LAND, h.seq, h.len);
+      tel(TDR_TEL_LAND, h.seq, h.len, h.coll);
       if (desc) {
         moved = h.len == 0 ||
                 par_cma_copy_from(peer_pid_, r.dst, h.aux, h.len);
@@ -1528,7 +1583,8 @@ class EmuQp : public Qp {
       complete_recv(r,
                     {r.wr_id,
                      moved ? TDR_WC_SUCCESS : TDR_WC_LOC_ACCESS_ERR,
-                     TDR_OP_RECV, h.len});
+                     TDR_OP_RECV, h.len},
+                    h.coll);
       release_recv(r);
       return sent;
     }
@@ -1540,7 +1596,7 @@ class EmuQp : public Qp {
       else retx_attempts_.erase(h.seq);
     }
     if (att <= seal_budget_) {
-      tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
+      tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att), h.coll);
       FrameHdr nak{};
       nak.op = OP_NAK;
       nak.seq = h.seq;
@@ -1549,7 +1605,8 @@ class EmuQp : public Qp {
     ack.status = TDR_WC_INTEGRITY_ERR;
     bool sent = send_frame(ack, nullptr, 0);
     complete_recv(r,
-                  {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len});
+                  {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len},
+                  h.coll);
     release_recv(r);
     return sent;
   }
@@ -1707,12 +1764,14 @@ class EmuQp : public Qp {
             ph->payload = std::move(buf);
             ph->len = h.len;
             ph->fb = fb;
+            ph->coll = h.coll;
             ph->awaiting_retx = false;
           } else {
             Unexpected u;
             u.fb = fb;
             u.seq = h.seq;
             u.len = h.len;
+            u.coll = h.coll;
             u.payload = std::move(buf);
             unexpected_.push_back(std::move(u));
           }
@@ -1731,6 +1790,7 @@ class EmuQp : public Qp {
             u.fb = fb;
             u.seq = h.seq;
             u.len = h.len;
+            u.coll = h.coll;
             u.awaiting_retx = true;
             unexpected_.push_back(std::move(u));
           }
@@ -1756,6 +1816,7 @@ class EmuQp : public Qp {
         u.fb = true;
         u.seq = h.seq;
         u.len = h.len;
+        u.coll = h.coll;
         u.payload = std::move(buf);
         bool sent = finish_foldback_sealed(r, u);
         release_recv(r);
@@ -1764,7 +1825,7 @@ class EmuQp : public Qp {
       tdr_wc wc = deliver_buffer_wc(r, buf.data(), h.len);
       ack.status = TDR_WC_SUCCESS;
       bool sent = send_frame(ack, nullptr, 0);
-      complete_recv(r, wc);
+      complete_recv(r, wc, h.coll);
       release_recv(r);
       return sent;
     }
@@ -1773,7 +1834,7 @@ class EmuQp : public Qp {
       return send_frame(ack, nullptr, 0);
     }
     if (send_nak) {
-      tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
+      tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att), h.coll);
       FrameHdr nak{};
       nak.op = OP_NAK;
       nak.seq = h.seq;
@@ -1784,7 +1845,8 @@ class EmuQp : public Qp {
       bool sent = send_frame(ack, nullptr, 0);
       if (have) {
         complete_recv(r,
-                      {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len});
+                      {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len},
+                      h.coll);
         release_recv(r);
       }
       return sent;
@@ -1812,7 +1874,7 @@ class EmuQp : public Qp {
       return send_frame(ack, nullptr, 0);
     }
     bool moved;
-    tel(TDR_TEL_LAND, h.seq, h.len);
+    tel(TDR_TEL_LAND, h.seq, h.len, h.coll);
     if (desc) {
       moved = par_cma_copy_from(peer_pid_, dst, h.aux, h.len);
     } else {
@@ -1846,7 +1908,7 @@ class EmuQp : public Qp {
         att = ++retx_attempts_[h.seq];
       }
       if (att <= seal_budget_) {
-        tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
+        tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att), h.coll);
         FrameHdr nak{};
         nak.op = OP_NAK;
         nak.seq = h.seq;
@@ -1875,7 +1937,7 @@ class EmuQp : public Qp {
         t.gen != static_cast<uint32_t>(local))
       ok = false;
     seal_count(ok ? kSealVerified : kSealFailed);
-    tel(ok ? TDR_TEL_VERIFY_OK : TDR_TEL_VERIFY_FAIL, h.seq, h.len);
+    tel(ok ? TDR_TEL_VERIFY_OK : TDR_TEL_VERIFY_FAIL, h.seq, h.len, h.coll);
     *ok_out = ok;
     return true;
   }
@@ -1936,7 +1998,7 @@ class EmuQp : public Qp {
         bool moved = land_cma_wc(r, h.aux, h.len, &wc);
         ack.status = moved ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
         bool sent = send_frame(ack, nullptr, 0);
-        complete_recv(r, wc);
+        complete_recv(r, wc, h.coll);
         release_recv(r);
         return sent;
       }
@@ -1964,6 +2026,7 @@ class EmuQp : public Qp {
               u->desc = true;
               u->src_va = h.aux;
               u->len = h.len;
+              u->coll = h.coll;
               u->awaiting_retx = false;
               return true;
             }
@@ -1978,6 +2041,7 @@ class EmuQp : public Qp {
           u.seq = h.seq;
           u.src_va = h.aux;
           u.len = h.len;
+          u.coll = h.coll;
           bool sent = finish_foldback(pr, u);
           release_recv(pr);
           return sent;
@@ -2003,6 +2067,7 @@ class EmuQp : public Qp {
                 it->payload = std::move(buf);
                 it->len = h.len;
                 it->fb = false;
+                it->coll = h.coll;
                 it->awaiting_retx = false;
               } else {
                 // CMA failure: the placeholder is dead (sender
@@ -2024,11 +2089,13 @@ class EmuQp : public Qp {
         if (now_parked) {
           if (moved) {
             complete_recv(pr, deliver_buffer_wc(pr, buf.data(),
-                                                buf.size()));
+                                                buf.size()),
+                          h.coll);
           } else {
             complete_recv(pr,
                           {pr.wr_id, TDR_WC_GENERAL_ERR, TDR_OP_RECV,
-                           h.len});
+                           h.len},
+                          h.coll);
           }
           release_recv(pr);
         } else if (!resolved) {
@@ -2084,6 +2151,7 @@ class EmuQp : public Qp {
           u.seq = h.seq;
           u.src_va = h.aux;
           u.len = h.len;
+          u.coll = h.coll;
           u.awaiting_retx = true;
           unexpected_.push_back(std::move(u));
         }
@@ -2100,7 +2168,7 @@ class EmuQp : public Qp {
       }
     }
     if (send_nak) {
-      tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
+      tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att), h.coll);
       FrameHdr nak{};
       nak.op = OP_NAK;
       nak.seq = h.seq;
@@ -2110,7 +2178,8 @@ class EmuQp : public Qp {
     bool sent = send_frame(ack, nullptr, 0);
     if (have) {
       complete_recv(r,
-                    {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len});
+                    {r.wr_id, TDR_WC_INTEGRITY_ERR, TDR_OP_RECV, h.len},
+                    h.coll);
       release_recv(r);
     }
     return sent;
@@ -2130,7 +2199,7 @@ class EmuQp : public Qp {
         if (att > seal_budget_) retx_attempts_.erase(h.seq);
       }
       if (att <= seal_budget_) {
-        tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att));
+        tel(TDR_TEL_NAK, h.seq, static_cast<uint64_t>(att), h.coll);
         FrameHdr nak{};
         nak.op = OP_NAK;
         nak.seq = h.seq;
@@ -2153,7 +2222,7 @@ class EmuQp : public Qp {
     ack.op = OP_WRITE_ACK;
     ack.seq = h.seq;
     if (dst) {
-      tel(TDR_TEL_LAND, h.seq, h.len);
+      tel(TDR_TEL_LAND, h.seq, h.len, h.coll);
       bool ok = par_cma_copy_from(peer_pid_, dst, h.aux, h.len);
       EmuEngine::dma_done(tmr);
       ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
@@ -2176,7 +2245,15 @@ class EmuQp : public Qp {
 
   void progress_loop() {
     FrameHdr h;
-    while (read_full(fd_, &h, sizeof(h))) {
+    while (read_full(fd_, &h, kFrameHdrWireBase)) {
+      // FEAT_COLL_ID extension: the trace-id word follows the base
+      // header on every frame of this connection (length agreed at
+      // handshake — never guessed per frame).
+      if (coll_wire_) {
+        if (!read_full(fd_, &h.coll, sizeof(h.coll))) break;
+      } else {
+        h.coll = 0;
+      }
       if (tel_on()) {
         switch (h.op) {
           case OP_WRITE:
@@ -2186,7 +2263,8 @@ class EmuQp : public Qp {
           case OP_SEND_FB:
           case OP_SEND_FB_DESC:
           case OP_READ_RESP:
-            tel_emit(TDR_TEL_WIRE_RX, eng_->tel_id, tel_id, h.seq, h.len);
+            tel_emit(TDR_TEL_WIRE_RX, eng_->tel_id, tel_id, h.seq, h.len,
+                     h.coll);
             break;
           default:
             break;
@@ -2223,6 +2301,8 @@ class EmuQp : public Qp {
           FrameHdr resp{};
           resp.op = OP_READ_RESP;
           resp.seq = h.seq;
+          resp.coll = h.coll;  // echo: the requester's landing joins
+                               // its own collective
           if (src) {
             resp.status = TDR_WC_SUCCESS;
             resp.len = h.len;
@@ -2281,6 +2361,7 @@ class EmuQp : public Qp {
           FrameHdr resp{};
           resp.op = OP_READ_RESP;
           resp.seq = h.seq;
+          resp.coll = h.coll;
           resp.len = 0;  // bytes move via CMA, none follow on the wire
           if (src) {
             // Push into the requester's destination: safe because its
@@ -2352,7 +2433,7 @@ class EmuQp : public Qp {
           }
           if (have) {
             seal_count(kSealRetx);
-            tel(TDR_TEL_RETX, h.seq, p.len);
+            tel(TDR_TEL_RETX, h.seq, p.len, p.coll);
             FrameHdr rh{};
             rh.op = p.wire_op;
             rh.status = 1;  // retransmission marker
@@ -2361,6 +2442,10 @@ class EmuQp : public Qp {
             rh.raddr = p.raddr;
             rh.len = p.len;
             rh.aux = reinterpret_cast<uint64_t>(p.src);
+            // Retransmissions keep the ORIGINAL collective id — the
+            // pending op recorded it at post time, so the healed
+            // frame's landing events still join the first attempt's.
+            rh.coll = p.coll;
             bool desc = p.wire_op == OP_WRITE_DESC ||
                         p.wire_op == OP_SEND_DESC ||
                         p.wire_op == OP_SEND_FB_DESC;
@@ -2468,20 +2553,22 @@ class EmuQp : public Qp {
       for (auto &kv : pending_) {
         cq_.push_back(
             {kv.second.wr_id, TDR_WC_FLUSH_ERR, kv.second.opcode, 0});
-        tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns);
+        tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns,
+               kv.second.coll);
         release_pending_mr(kv.second.mr);
       }
       pending_.clear();
       for (auto &r : recvs_) {
         recv_done_[r.ticket] = {r.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
-        tel_wc(r.wr_id, TDR_WC_FLUSH_ERR, 0, r.post_ns);
+        tel_wc(r.wr_id, TDR_WC_FLUSH_ERR, 0, r.post_ns, r.coll);
         release_recv(r);
       }
       recvs_.clear();
       for (auto &kv : parked_) {
         recv_done_[kv.second.ticket] =
             {kv.second.wr_id, TDR_WC_FLUSH_ERR, TDR_OP_RECV, 0};
-        tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns);
+        tel_wc(kv.second.wr_id, TDR_WC_FLUSH_ERR, 0, kv.second.post_ns,
+               kv.second.coll);
         release_recv(kv.second);
       }
       parked_.clear();
@@ -2498,13 +2585,14 @@ class EmuQp : public Qp {
     if (it == pending_.end()) return;
     tdr_wc wc{it->second.wr_id, status, it->second.opcode, it->second.len};
     uint64_t post_ns = it->second.post_ns;
+    uint64_t coll = it->second.coll;
     cq_.push_back(wc);
     release_pending_mr(it->second.mr);
     pending_.erase(it);
     cv_.notify_all();
     lk.unlock();
     eng_->cq_pulse();
-    tel_wc(wc.wr_id, wc.status, wc.len, post_ns);
+    tel_wc(wc.wr_id, wc.status, wc.len, post_ns, coll);
   }
 
   EmuEngine *eng_;
@@ -2528,6 +2616,9 @@ class EmuQp : public Qp {
   bool seal_ = false;
   bool seal_payload_ = false;
   int seal_budget_ = 3;
+  // FEAT_COLL_ID negotiated: frame headers carry the collective trace
+  // id (fixed at handshake; both ends read/write the extended length).
+  bool coll_wire_ = false;
 
   std::mutex send_mu_;  // serializes frame submission on the socket
 

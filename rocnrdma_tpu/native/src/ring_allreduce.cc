@@ -138,12 +138,13 @@ struct RingTelScope {
   uint64_t seq = 0;
   uint64_t nbytes = 0;
   uint64_t t0 = 0;
+  uint64_t coll = 0;
   bool done = false;
   RingTelScope(tdr_ring *r, uint64_t bytes);
   void record(int rc) {
     done = true;
     uint64_t dt_ns = tdr::tel_now_ns() - t0;
-    tdr::tel_emit(TDR_TEL_RING_END, eng, 0, seq, rc == 0 ? 0 : 1);
+    tdr::tel_emit(TDR_TEL_RING_END, eng, 0, seq, rc == 0 ? 0 : 1, coll);
     tdr::tel_hist_add(TDR_HIST_RING_LAT_US, dt_ns / 1000);
     if (rc == 0 && dt_ns > 0)
       tdr::tel_hist_add(TDR_HIST_RING_MBPS, nbytes * 1000 / dt_ns);
@@ -246,6 +247,17 @@ struct tdr_ring {
     return tmp_mr;
   }
 
+  // Collective trace ids (fleet tracing). next_coll: the id the
+  // CALLER stamped for the next collective (tdr_ring_set_coll;
+  // sticky, captured at blocking entry or async submission).
+  // auto_coll: fallback counter for rings whose caller never stamps —
+  // auto ids carry bit 63 so the two id spaces never collide.
+  // cur_coll: the id of the collective currently RUNNING on this ring
+  // (what the fold/fold_off event sites read).
+  std::atomic<uint64_t> next_coll{0};
+  std::atomic<uint64_t> auto_coll{0};
+  std::atomic<uint64_t> cur_coll{0};
+
   // Async driver (tdr_ring_start): one dedicated thread per ring,
   // spawned at the first start and joined at destroy, executing
   // queued ops strictly in submission order — submission order IS the
@@ -280,6 +292,11 @@ struct tdr_ring_op {
   // through these handles).
   enum { kAllreduce = 0, kReduceScatter = 1, kAllGather = 2 };
   int kind = kAllreduce;
+  // Collective trace id captured at SUBMISSION (the caller stamps the
+  // ring, then starts): the driver re-arms it when the op actually
+  // runs, so a queue of bucketed ops keeps per-op ids whatever the
+  // interleaving of set_coll calls for later submissions.
+  uint64_t coll = 0;
   std::mutex mu;
   std::condition_variable cv;
   bool done = false;  // under mu
@@ -288,6 +305,27 @@ struct tdr_ring_op {
 };
 
 namespace {
+
+// Driver-forced collective id: the async driver hands each op's
+// captured id to the blocking collective it runs on its own thread —
+// a thread-local, so it can never race a caller thread's
+// set_coll/start pair for a LATER op.
+thread_local uint64_t t_forced_coll = 0;
+
+// Resolve the collective trace id for a collective that is starting:
+// the driver's forced id, else the caller-stamped next_coll, else an
+// auto id (bit 63 set — disjoint from caller-stamped ids).
+uint64_t take_coll(tdr_ring *r) {
+  uint64_t v = t_forced_coll;
+  if (v) {
+    t_forced_coll = 0;
+    return v;
+  }
+  v = r->next_coll.load(std::memory_order_relaxed);
+  if (v) return v;
+  return (1ull << 63) |
+         (r->auto_coll.fetch_add(1, std::memory_order_relaxed) + 1);
+}
 
 void op_complete(tdr_ring_op *op, int rc, const std::string &err) {
   {
@@ -324,6 +362,7 @@ void async_driver(tdr_ring *r) {
       continue;
     }
     int rc;
+    t_forced_coll = op->coll;  // submission-time id, re-armed at run
     switch (op->kind) {
       case tdr_ring_op::kReduceScatter:
         rc = tdr_ring_reduce_scatter(r, op->data, op->count, op->dtype,
@@ -378,8 +417,21 @@ RingTelScope::RingTelScope(tdr_ring *r, uint64_t bytes) {
   eng = reinterpret_cast<tdr::Engine *>(r->eng)->tel_id;
   seq = g_ring_call_seq.fetch_add(1, std::memory_order_relaxed) + 1;
   nbytes = bytes;
+  // Resolve and propagate the collective trace id: the ring remembers
+  // it for the fold event sites, and every neighbor QP's posting path
+  // (and, FEAT_COLL_ID negotiated, its outbound frame headers) stamps
+  // it until the next collective re-stamps. One store per QP per
+  // collective — noise next to the MB-scale transfers it labels.
+  coll = take_coll(r);
+  r->cur_coll.store(coll, std::memory_order_relaxed);
+  for (tdr_qp *q : r->lefts)
+    reinterpret_cast<tdr::Qp *>(q)->cur_coll.store(
+        coll, std::memory_order_relaxed);
+  for (tdr_qp *q : r->rights)
+    reinterpret_cast<tdr::Qp *>(q)->cur_coll.store(
+        coll, std::memory_order_relaxed);
   t0 = tdr::tel_now_ns();
-  tdr::tel_emit(TDR_TEL_RING_BEGIN, eng, 0, seq, nbytes);
+  tdr::tel_emit(TDR_TEL_RING_BEGIN, eng, 0, seq, nbytes, coll);
 }
 }  // namespace
 
@@ -443,6 +495,10 @@ int tdr_ring_channels(const tdr_ring *r) {
 
 size_t tdr_ring_chunk_bytes(void) { return ring_chunk_bytes(); }
 
+void tdr_ring_set_coll(tdr_ring *r, uint64_t coll_id) {
+  if (r) r->next_coll.store(coll_id, std::memory_order_relaxed);
+}
+
 void tdr_ring_destroy(tdr_ring *r) {
   if (!r) return;
   // Quiesce the async driver FIRST: a queued op must fail fast (its
@@ -478,6 +534,10 @@ static tdr_ring_op *ring_start_kind(tdr_ring *r, void *data, size_t count,
   op->dtype = dtype;
   op->red_op = red_op;
   op->kind = kind;
+  // Capture the caller-stamped trace id NOW (submission order is the
+  // SPMD contract, so submission is when the id binds); the driver
+  // re-arms it when the op runs.
+  op->coll = take_coll(r);
   {
     std::lock_guard<std::mutex> g(r->amu);
     if (r->astop) {
@@ -1018,7 +1078,8 @@ struct StepPipe {
     tdr::reduce_any(cdata + recv_off_ + idx * chunk,
                     r->tmp.data() + (idx % slots) * slot_bytes, len / esz,
                     dtype, red_op);
-    TDR_TEL(TDR_TEL_FOLD, eng_tel, tdr::tel_thread_track(), idx, len);
+    TDR_TELC(TDR_TEL_FOLD, eng_tel, tdr::tel_thread_track(), idx, len,
+             r->cur_coll.load(std::memory_order_relaxed));
     std::lock_guard<std::mutex> g(hub.mu);
     fold_done[idx] = 1;
     folded++;
@@ -1117,8 +1178,8 @@ struct StepPipe {
         }
         // Fold enqueued straight from the progress (shard) thread;
         // the job publishes its watermark back on the hub condvar.
-        TDR_TEL(TDR_TEL_FOLD_OFF, eng_tel, tdr::tel_thread_track(), idx,
-                len);
+        TDR_TELC(TDR_TEL_FOLD_OFF, eng_tel, tdr::tel_thread_track(), idx,
+                 len, r->cur_coll.load(std::memory_order_relaxed));
         tdr::fold_submit([this, idx] { fold_chunk(idx); });
       } else {
         // Inline fallback (no fold workers): the legacy path, with

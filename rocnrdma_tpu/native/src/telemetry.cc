@@ -11,7 +11,7 @@
 // whose off state costs one predicted branch per event site.
 //
 // Concurrency model: producers are the posting threads and each QP's
-// progress thread. Events are 32 bytes; recording takes a short
+// progress thread. Events are 40 bytes; recording takes a short
 // mutex-protected append (the "drained under the engine lock" option
 // the design allows — contention is negligible next to the payload
 // copies the instrumented paths perform, and a mutex keeps the drain
@@ -152,8 +152,8 @@ uint64_t tel_now_ns() {
 }
 
 void tel_emit(uint16_t type, uint16_t engine, uint32_t qp, uint64_t id,
-              uint64_t arg) {
-  tdr_tel_event ev{tel_now_ns(), type, engine, qp, id, arg};
+              uint64_t arg, uint64_t coll) {
+  tdr_tel_event ev{tel_now_ns(), type, engine, qp, id, arg, coll};
   std::lock_guard<std::mutex> g(g_mu);
   if (g_ring.empty()) return;  // reset raced a producer: drop quietly
   size_t cap = g_ring.size();

@@ -51,6 +51,12 @@ uint32_t local_features() {
   // whole detect→NAK→retransmit ladder over same-host worlds); the
   // default there seals the tag only — see FEAT_SEAL_CMA_FULL.
   if (env_set("TDR_SEAL_CMA")) f |= FEAT_SEAL_CMA_FULL;
+  // Wire-carried collective trace ids ride only when this rank is
+  // recording: with telemetry off the advertisement — and with it the
+  // frame-header extension — vanishes, keeping frames byte-identical
+  // to the pre-trace-id format (the one-branch-guard contract's wire
+  // counterpart).
+  if (tel_on()) f |= FEAT_COLL_ID;
   return f;
 }
 

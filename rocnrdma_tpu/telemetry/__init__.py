@@ -28,14 +28,17 @@ Typical use::
 """
 
 from rocnrdma_tpu.telemetry.recorder import (  # noqa: F401
-    TelEvent, counters, disable, drain, enable, enabled, histograms,
-    hist_percentile, hist_percentiles, overlap_fraction, python_events,
-    reset, snapshot, start_snapshot_writer, timeline)
-from rocnrdma_tpu.telemetry.perfetto import export_trace  # noqa: F401
+    TelEvent, counters, disable, drain, enable, enabled,
+    events_from_wire, events_to_wire, histograms, hist_percentile,
+    hist_percentiles, overlap_fraction, python_events, reset, snapshot,
+    start_snapshot_writer, timeline)
+from rocnrdma_tpu.telemetry.perfetto import (  # noqa: F401
+    collect_and_merge, export_trace, merge_fleet)
 
 __all__ = [
-    "TelEvent", "counters", "disable", "drain", "enable", "enabled",
+    "TelEvent", "collect_and_merge", "counters", "disable", "drain",
+    "enable", "enabled", "events_from_wire", "events_to_wire",
     "export_trace", "histograms", "hist_percentile", "hist_percentiles",
-    "overlap_fraction", "python_events", "reset", "snapshot",
-    "start_snapshot_writer", "timeline",
+    "merge_fleet", "overlap_fraction", "python_events", "reset",
+    "snapshot", "start_snapshot_writer", "timeline",
 ]

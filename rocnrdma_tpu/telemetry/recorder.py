@@ -32,6 +32,10 @@ class TelEvent:
     arg: int = 0         # bytes / status / attempt (per event type)
     source: str = "native"
     fields: Dict[str, Any] = field(default_factory=dict)
+    # Collective trace id (0 = none): stamped by the posting rank,
+    # wire-carried to the peer under FEAT_COLL_ID — the join key for
+    # cross-rank timeline merges. Bit 63 set = ring auto-assigned.
+    coll: int = 0
 
 
 def enabled() -> bool:
@@ -87,7 +91,7 @@ def drain(max_events: int = 1 << 20) -> List[TelEvent]:
         out.append(TelEvent(
             ts_ns=int(raw.ts_ns), name=_event_name(eng, raw.type),
             engine=int(raw.engine), qp=int(raw.qp), id=int(raw.id),
-            arg=int(raw.arg), source="native"))
+            arg=int(raw.arg), source="native", coll=int(raw.coll)))
     return out
 
 
@@ -113,6 +117,40 @@ def timeline(include_python: bool = True,
         events.extend(python_events())
     events.sort(key=lambda e: e.ts_ns)
     return events
+
+
+def events_to_wire(events: Iterable[TelEvent]) -> List[list]:
+    """JSON-safe encoding of a timeline segment for the control-plane
+    trace push (one short list per event — native events keep their
+    numeric tracks, python events keep their field dicts)."""
+    out: List[list] = []
+    for e in events:
+        if e.source == "native":
+            out.append([int(e.ts_ns), e.name, int(e.engine), int(e.qp),
+                        int(e.id), int(e.arg), int(e.coll)])
+        else:
+            out.append([int(e.ts_ns), e.name, dict(e.fields)])
+    return out
+
+
+def events_from_wire(wire: Iterable[list]) -> List[TelEvent]:
+    """Inverse of :func:`events_to_wire` (tolerant: malformed entries
+    are skipped — a diagnostics channel must not take the reader
+    down)."""
+    out: List[TelEvent] = []
+    for w in wire or ():
+        try:
+            if len(w) == 3 and isinstance(w[2], dict):
+                out.append(TelEvent(ts_ns=int(w[0]), name=str(w[1]),
+                                    source="python", fields=dict(w[2])))
+            elif len(w) >= 7:
+                out.append(TelEvent(
+                    ts_ns=int(w[0]), name=str(w[1]), engine=int(w[2]),
+                    qp=int(w[3]), id=int(w[4]), arg=int(w[5]),
+                    source="native", coll=int(w[6])))
+        except (TypeError, ValueError, IndexError):
+            continue
+    return out
 
 
 def counters() -> Dict[str, int]:
@@ -182,9 +220,47 @@ def hist_percentiles(buckets: Sequence[int],
     return {f"p{q:g}": hist_percentile(buckets, q) for q in qs}
 
 
+_warned_tainted = False
+# Drop-counter watermark: the cumulative native dropped count last
+# observed by a window-delimiting reader (overlap_fraction's own
+# drain). Deltas against it scope the taint to the MEASURED window —
+# one warmup overflow ages out instead of tainting every later clean
+# window for the life of the process.
+_drop_mark = 0
+
+
+def _dropped_delta() -> int:
+    global _drop_mark
+    from rocnrdma_tpu.transport import engine as eng
+
+    cur = int(eng.telemetry_dropped())
+    # A reset shrinks the cumulative counter: re-anchor, report clean.
+    delta = cur - _drop_mark if cur >= _drop_mark else 0
+    _drop_mark = cur
+    return delta
+
+
+def _warn_tainted_once(what: str, dropped: int) -> None:
+    """Warn (once per process) that a derived fraction was computed
+    over a ring window that overwrote events — a silently truncated
+    ring skews every event-count-derived number."""
+    global _warned_tainted
+    if _warned_tainted:
+        return
+    _warned_tainted = True
+    import warnings
+
+    warnings.warn(
+        f"{what}: the telemetry ring dropped {dropped} events inside "
+        "the measured window (overwrite-oldest); event-derived "
+        "fractions are skewed. Raise TDR_TELEMETRY_RING or drain more "
+        "often.", RuntimeWarning, stacklevel=3)
+
+
 def overlap_fraction(events: Optional[Sequence[TelEvent]] = None,
                      span: str = "trainer.grads",
-                     wire: Sequence[str] = ("wire_tx", "wire_rx")
+                     wire: Sequence[str] = ("wire_tx", "wire_rx"),
+                     dropped: Optional[int] = None
                      ) -> Dict[str, Any]:
     """Measured backward-overlap of a recorded window: the fraction of
     native WIRE events (frame tx/rx instants) whose timestamps fall
@@ -199,9 +275,23 @@ def overlap_fraction(events: Optional[Sequence[TelEvent]] = None,
 
     ``events`` is a merged timeline (``telemetry.timeline()``); when
     None the native ring is drained now. Spans overlapping across
-    steps are merged before counting."""
+    steps are merged before counting.
+
+    ``dropped``: events the native ring overwrote during the measured
+    window. When None and this call drains the ring itself, the drop
+    count DELTA since the previous window-delimiting drain is used
+    (cumulative would taint every later clean window after one warmup
+    overflow). Nonzero taints the estimate — wire events silently
+    vanished, so the fraction is skewed — and the result carries
+    ``tainted=True`` plus a once-per-process RuntimeWarning instead of
+    a silently wrong number."""
     if events is None:
+        if dropped is None:
+            dropped = _dropped_delta()
         events = timeline()
+    tainted = bool(dropped)
+    if tainted:
+        _warn_tainted_once("overlap_fraction", int(dropped))
     spans: List[List[int]] = []
     for e in events:
         if e.source == "python" and e.name == span and "dur_s" in e.fields:
@@ -230,6 +320,8 @@ def overlap_fraction(events: Optional[Sequence[TelEvent]] = None,
         "wire_events": total,
         "wire_in_span": inside,
         "overlap_fraction": round(inside / total, 4) if total else 0.0,
+        "dropped": int(dropped or 0),
+        "tainted": tainted,
     }
 
 

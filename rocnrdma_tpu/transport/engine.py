@@ -84,7 +84,7 @@ class Wc(ctypes.Structure):
 
 
 class TelEventC(ctypes.Structure):
-    """Mirror of the native tdr_tel_event (32 bytes, fixed layout)."""
+    """Mirror of the native tdr_tel_event (40 bytes, fixed layout)."""
 
     _fields_ = [
         ("ts_ns", ctypes.c_uint64),
@@ -93,6 +93,8 @@ class TelEventC(ctypes.Structure):
         ("qp", ctypes.c_uint32),
         ("id", ctypes.c_uint64),
         ("arg", ctypes.c_uint64),
+        # Collective trace id (0 = none; bit 63 = ring auto-assigned).
+        ("coll", ctypes.c_uint64),
     ]
 
 
@@ -216,9 +218,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_channels.argtypes = [P]
     lib.tdr_ring_chunk_bytes.restype = ctypes.c_size_t
     lib.tdr_ring_chunk_bytes.argtypes = []
+    lib.tdr_ring_set_coll.restype = None
+    lib.tdr_ring_set_coll.argtypes = [P, ctypes.c_uint64]
     lib.tdr_fold_pool_workers.restype = ctypes.c_size_t
     lib.tdr_qp_has_seal_payload.restype = ctypes.c_int
     lib.tdr_qp_has_seal_payload.argtypes = [P]
+    lib.tdr_qp_has_coll_id.restype = ctypes.c_int
+    lib.tdr_qp_has_coll_id.argtypes = [P]
     lib.tdr_ring_register.restype = ctypes.c_int
     lib.tdr_ring_register.argtypes = [P, P, ctypes.c_size_t]
     lib.tdr_ring_unregister.restype = ctypes.c_int
@@ -828,6 +834,16 @@ class QueuePair:
             _live(self._h, "has_seal_payload")))
 
     @property
+    def has_coll_id(self) -> bool:
+        """Both ends negotiated wire-carried collective trace ids
+        (FEAT_COLL_ID): frame headers carry the posting rank's coll id
+        so the peer's telemetry events join by key. Advertised only
+        when TDR_TELEMETRY was on at handshake time — with the feature
+        off, frames are byte-identical to the pre-trace-id format."""
+        return bool(_load().tdr_qp_has_coll_id(
+            _live(self._h, "has_coll_id")))
+
+    @property
     def telemetry_id(self) -> int:
         """Flight-recorder track id of this QP (bring-up ordinal;
         names the per-QP timeline in Perfetto exports)."""
@@ -975,6 +991,16 @@ class Ring:
     def channels(self) -> int:
         """Channel count (independent QPs per neighbor) of this ring."""
         return int(_load().tdr_ring_channels(_live(self._h, "channels")))
+
+    def set_coll(self, coll_id: int) -> None:
+        """Stamp the collective trace id for the NEXT collective on
+        this ring (blocking call or async start). The id tags every
+        native telemetry event of that collective and rides the frame
+        header to the peer when FEAT_COLL_ID was negotiated, making
+        two ranks' events joinable by key in a merged fleet timeline.
+        Observational only — never negotiated, never in the digest."""
+        _load().tdr_ring_set_coll(_live(self._h, "ring_set_coll"),
+                                  int(coll_id))
 
     def register_buffer(self, array) -> None:
         """Front-load MR registration for a buffer the caller promises

@@ -1,0 +1,562 @@
+"""Fleet-scope tracing tests (ISSUE 13).
+
+Pins the cross-rank observability contracts:
+- wire-carried collective ids: posting-side events and the PEER's
+  wire_rx/land/verify/wc events carry the SAME ``coll`` (negotiated
+  FEAT_COLL_ID; off — and wire-format-neutral — without telemetry);
+- a corrupt-rider NAK/retransmit keeps the ORIGINAL coll id on the
+  retransmitted frame's events;
+- the NTP-style clock-offset estimate is bounded by the measured RTT
+  and monotone under the min-RTT filter;
+- a TWO-PROCESS world-2 collect_trace merge joins one collective's
+  send-side and land-side events across ranks by id;
+- postmortem bundles are written per rank on rebuild and merge via
+  tdr_explain; /metrics serves the new contract names;
+- overlap_fraction refuses to report an untainted number over a
+  window that overlapped telemetry drops;
+- Perfetto tier-ring lanes label tier=intra|inter.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu import telemetry
+from rocnrdma_tpu.collectives.world import local_worlds
+from rocnrdma_tpu.telemetry.recorder import TelEvent, events_from_wire
+from rocnrdma_tpu.transport.engine import (TransportError,
+                                           fault_plan_reset,
+                                           telemetry_reset)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(autouse=True)
+def _trace_env():
+    keys = ("TDR_TELEMETRY", "TDR_TELEMETRY_RING", "TDR_FAULT_PLAN",
+            "TDR_SEAL_CMA", "TDR_POSTMORTEM_DIR")
+    saved = {k: os.environ.get(k) for k in keys}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    telemetry_reset()
+    fault_plan_reset()
+
+
+def _run_world2(iters=2, **world_kwargs):
+    """World-2 in-process soak; returns the drained merged timeline."""
+    worlds = local_worlds(2, free_port(), **world_kwargs)
+    try:
+        assert worlds[0].left_qp.has_coll_id
+        bufs = [np.ones(1 << 12, dtype=np.float32) for _ in range(2)]
+        for _ in range(iters):
+            ts = [threading.Thread(target=worlds[r].allreduce,
+                                   args=(bufs[r],)) for r in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        return telemetry.timeline(), worlds[0].engine.telemetry_id, \
+            worlds[1].engine.telemetry_id
+    finally:
+        for w in worlds:
+            w.close()
+
+
+# ------------------------------------------------------ coll-id plumbing
+
+def test_coll_id_joins_ranks_in_one_collective():
+    """The posting rank's events and the PEER's landing-side events
+    for one collective carry the same wire-carried coll id — the
+    first time two ranks' flight recorders are joinable by key."""
+    telemetry.enable()
+    events, eng0, eng1 = _run_world2()
+    native = [e for e in events if e.source == "native" and e.coll]
+    assert native, "no coll-tagged events recorded"
+    # Pick a collective that engine0's ring ran; its wire_tx events
+    # must pair with wire_rx/land/wc events ON THE OTHER ENGINE with
+    # the same id (the frame carried it).
+    begins = [e for e in native if e.name == "ring_begin"
+              and e.engine == eng0]
+    assert begins
+    coll = begins[0].coll
+    assert coll  # world.py stamped it (not the native auto id)
+    assert not (coll >> 63), "expected a caller-stamped id"
+    peer = [e for e in native if e.coll == coll and e.engine == eng1]
+    peer_names = {e.name for e in peer}
+    assert {"wire_rx", "wc"} <= peer_names, peer_names
+    assert "land" in peer_names or "fold" in peer_names, peer_names
+    # Posting side carries it too.
+    mine = {e.name for e in native
+            if e.coll == coll and e.engine == eng0}
+    assert "wire_tx" in mine and "ring_end" in mine
+
+
+def test_coll_seq_is_per_world_monotonic():
+    """Both ranks assign the same per-world monotonic sequence (the
+    SPMD order IS the key agreement): collective k on rank 0 and
+    collective k on rank 1 share one id."""
+    telemetry.enable()
+    events, eng0, eng1 = _run_world2(iters=3)
+    for eng in (eng0, eng1):
+        seq = [e.coll for e in events
+               if e.source == "native" and e.name == "ring_begin"
+               and e.engine == eng and not (e.coll >> 63)]
+        assert seq == sorted(seq)
+        assert len(set(seq)) == len(seq)
+    c0 = {e.coll for e in events if e.source == "native"
+          and e.name == "ring_begin" and e.engine == eng0}
+    c1 = {e.coll for e in events if e.source == "native"
+          and e.name == "ring_begin" and e.engine == eng1}
+    assert c0 == c1  # same collectives, same ids, both rings
+
+
+def test_no_coll_wire_without_telemetry():
+    """Telemetry off => FEAT_COLL_ID is not advertised: the handshake
+    resolves to the legacy wire format (frames byte-identical to the
+    pre-trace-id framing) and nothing records."""
+    os.environ["TDR_TELEMETRY"] = "0"
+    telemetry_reset()
+    worlds = local_worlds(2, free_port())
+    try:
+        assert not worlds[0].left_qp.has_coll_id
+        assert not worlds[1].right_qp.has_coll_id
+        buf = [np.ones(512, dtype=np.float32) for _ in range(2)]
+        ts = [threading.Thread(target=worlds[r].allreduce,
+                               args=(buf[r],)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert (buf[0] == 2).all()
+        assert not telemetry.drain(100)
+    finally:
+        for w in worlds:
+            w.close()
+
+
+def test_corrupt_rider_retx_keeps_coll_id():
+    """A corrupt-rider NAK/retransmit cycle keeps the ORIGINAL coll
+    id: the verify_fail, nak, retx, and the healed verify_ok all tag
+    with the collective the first transmission belonged to."""
+    # send-site corruption flips the WIRE copy of one sealed frame
+    # mid-collective (nth=7 clears the bootstrap generation-exchange
+    # sends, whose frames predate any collective id): the land-side
+    # verify fails, NAKs, and the sender retransmits clean.
+    os.environ["TDR_SEAL_CMA"] = "1"  # full payload CRC on CMA tier
+    os.environ["TDR_FAULT_PLAN"] = "send:nth=7:corrupt=2"
+    fault_plan_reset()
+    telemetry.enable()
+    events, _, _ = _run_world2(iters=3)
+    native = [e for e in events if e.source == "native"]
+    retx = [e for e in native if e.name == "retx"]
+    assert retx, "corrupt rider never armed (no retransmission)"
+    for r in retx:
+        assert r.coll, "retransmission lost its coll id"
+        fails = [e for e in native if e.name == "verify_fail"
+                 and e.id == r.id]
+        naks = [e for e in native if e.name == "nak" and e.id == r.id]
+        assert fails and naks
+        assert all(e.coll == r.coll for e in fails + naks)
+        heals = [e for e in native if e.name == "verify_ok"
+                 and e.id == r.id and e.ts_ns > r.ts_ns]
+        assert heals and all(e.coll == r.coll for e in heals)
+
+
+# ------------------------------------------------------------ clock sync
+
+def test_clock_sync_min_rtt_filter_bounds_and_monotone():
+    from rocnrdma_tpu.control.client import ClockSync
+
+    cs = ClockSync()
+    # Symmetric exchange, true offset 1000ns, rtt 400ns.
+    assert cs.sample(0, 1200, 1300, 500) is True
+    assert cs.rtt_ns == 400
+    assert abs(cs.offset_ns - 1000) <= cs.rtt_ns // 2
+    # Worse RTT: discarded, estimate unchanged (monotone filter).
+    assert cs.sample(0, 9000, 9100, 5000) is False
+    assert cs.offset_ns == 1000 and cs.rtt_ns == 400
+    # Better RTT: adopted; the bound tightens.
+    assert cs.sample(0, 1050, 1060, 110) is True
+    assert cs.rtt_ns == 100
+    assert abs(cs.offset_ns - 1000) <= 50
+    # Negative RTT (garbled echo): discarded before it even counts.
+    assert cs.sample(0, 500, 5000, 100) is False
+    assert cs.samples == 3
+
+    # Property: rtt_ns never increases over an arbitrary stream.
+    rng = np.random.default_rng(7)
+    cs2 = ClockSync()
+    last = None
+    for _ in range(200):
+        t0 = int(rng.integers(0, 1 << 30))
+        d1 = int(rng.integers(1, 10000))
+        srv = int(rng.integers(1, 5000))
+        d2 = int(rng.integers(1, 10000))
+        cs2.sample(t0, t0 + d1, t0 + d1 + srv, t0 + d1 + srv + d2)
+        if last is not None:
+            assert cs2.rtt_ns <= last
+        last = cs2.rtt_ns
+
+
+def test_clock_offset_live_is_rtt_bounded():
+    """A real heartbeat exchange against a live coordinator yields an
+    estimate bounded by its measured RTT (same host: the true offset
+    is ~0, so |estimate| <= rtt/2 <= rtt)."""
+    from rocnrdma_tpu.control.client import ControlClient
+    from rocnrdma_tpu.control.coordinator import Coordinator
+
+    coord = Coordinator(port=0, port_base=free_port()).start()
+    try:
+        worlds = local_worlds(2, None, controller=coord.address,
+                              world_name="clock")
+        try:
+            for w in worlds:
+                for _ in range(3):
+                    assert w._hb.beat()
+                st = w._hb.clock.state()
+                assert st["clock_samples"] >= 3
+                assert st["clock_rtt_ns"] > 0
+                assert abs(st["clock_offset_ns"]) <= st["clock_rtt_ns"]
+            # The pushed estimates serve on /metrics under the pinned
+            # names.
+            m = ControlClient(coord.address).metrics()
+            assert 'tdr_clock_offset_us{world="clock",rank="0"}' in m
+            assert 'tdr_clock_rtt_us{world="clock",rank="1"}' in m
+            assert 'tdr_postmortems_total{world="clock"}' in m
+            # telemetry.dropped rides the registry family per rank —
+            # the taint signal a scraper watches.
+            assert 'tdr_telemetry_dropped_total{world="clock"}' in m
+            assert ('tdr_telemetry_dropped_total{world="clock",'
+                    'rank="0"}') in m
+        finally:
+            for w in worlds:
+                w.close()
+    finally:
+        coord.stop()
+
+
+# ------------------------------------------------- two-process merge
+
+_RANK_SCRIPT = r"""
+import sys, time
+import numpy as np
+from rocnrdma_tpu.collectives.world import RingWorld
+from rocnrdma_tpu.transport.engine import Engine
+
+rank, coord = int(sys.argv[1]), sys.argv[2]
+eng = Engine("emu")
+w = RingWorld(eng, rank, 2, controller=coord, world_name="merge2",
+              timeout_ms=20000)
+buf = np.zeros(1 << 13, dtype=np.float32)
+for i in range(400):
+    buf[:] = rank + 1
+    w.allreduce(buf)
+    assert (buf == 3).all()
+    time.sleep(0.02)
+w.close(); eng.close()
+"""
+
+
+def test_two_process_collect_trace_joins_by_coll():
+    """World-2, one PROCESS per rank (separate native rings — the
+    real fleet shape): a mid-soak collect_trace returns both ranks'
+    segments, and the same collective's send-side events on rank 0
+    join its land-side events on rank 1 by the wire-carried id."""
+    from rocnrdma_tpu.control.client import ControlClient
+    from rocnrdma_tpu.control.coordinator import Coordinator
+    from rocnrdma_tpu.telemetry.perfetto import merge_fleet
+
+    coord = Coordinator(port=0, lease_ms=4000,
+                        port_base=free_port()).start()
+    env = dict(os.environ, TDR_TELEMETRY="1", JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _RANK_SCRIPT, str(r), coord.address],
+        env=env, cwd=REPO) for r in range(2)]
+    try:
+        time.sleep(4.0)
+        resp = ControlClient(coord.address).collect_trace(
+            "merge2", timeout_s=30.0)
+        assert resp.get("ok"), resp.get("error")
+        segments = resp["segments"]
+        assert sorted(segments) == ["0", "1"]
+    finally:
+        rcs = []
+        for p in procs:
+            try:
+                rcs.append(p.wait(timeout=90))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs.append(-9)
+        coord.stop()
+    assert rcs == [0, 0]
+
+    per_rank = {int(r): events_from_wire(s["events"])
+                for r, s in segments.items()}
+    send0 = {e.coll for e in per_rank[0]
+             if e.source == "native" and e.coll
+             and e.name in ("post_send", "wire_tx")}
+    land1 = {e.coll for e in per_rank[1]
+             if e.source == "native" and e.coll
+             and e.name in ("wire_rx", "land", "wc")}
+    joined = send0 & land1
+    assert len(joined) >= 3, (len(send0), len(land1))
+    # Clock estimates rode the segments.
+    for s in segments.values():
+        assert int(s.get("clock_rtt_ns", 0)) > 0
+    # And the merge is a valid fleet-shaped Perfetto doc.
+    doc = json.loads(json.dumps(merge_fleet(segments)))
+    pids = {e["pid"] // 1000 for e in doc["traceEvents"]}
+    assert {1, 2} <= pids
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "ring_begin" in names and "wire_rx" in names
+
+    # tdr_explain consumes the same segments.
+    from tdr_explain import analyze_segments
+
+    analysis = analyze_segments(segments)
+    assert analysis["joinable_collectives"] >= 3
+    assert analysis["straggler"]["rank"] in (0, 1)
+    assert not analysis["tainted_ranks"]
+
+
+# ------------------------------------------------------- postmortems
+
+def test_postmortem_bundles_write_and_merge(tmp_path):
+    """A TransportError→rebuild dumps one bundle per rank keyed by
+    (world, generation); tdr_explain --postmortem merges them."""
+    os.environ["TDR_POSTMORTEM_DIR"] = str(tmp_path)
+    telemetry.enable()
+    worlds = local_worlds(2, free_port(), world_name="pmworld")
+    try:
+        bufs = [np.ones(1 << 12, dtype=np.float32) for _ in range(2)]
+        ts = [threading.Thread(target=worlds[r].allreduce,
+                               args=(bufs[r],)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # Kill rank 1's transport: its next collective is retryable,
+        # and BOTH ranks walk the rebuild ladder.
+        worlds[1]._teardown()
+        with pytest.raises(TransportError) as ei:
+            worlds[1].allreduce(bufs[1])
+        assert ei.value.retryable
+        errs = [None, None]
+
+        def rb(r):
+            try:
+                worlds[r].rebuild(reason="test incident")
+            except BaseException as e:  # pragma: no cover
+                errs[r] = e
+
+        ts = [threading.Thread(target=rb, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert errs == [None, None]
+        assert worlds[0]._postmortems == 1
+    finally:
+        for w in worlds:
+            w.close()
+
+    inc_dir = tmp_path / "pmworld" / "incident-g0"
+    bundles = sorted(p.name for p in inc_dir.iterdir())
+    assert bundles == ["rank0.json", "rank1.json"]
+    b0 = json.loads((inc_dir / "rank0.json").read_text())
+    assert b0["format"] == "tdr-postmortem-v1"
+    assert b0["world"] == "pmworld" and b0["rank"] == 0
+    assert b0["generation"] == 0
+    assert b0["error"] == "test incident"
+    assert "integrity.sealed" in b0["counters"]
+    assert isinstance(b0["events"], list) and b0["events"]
+
+    from tdr_explain import explain_postmortem
+
+    merged = explain_postmortem(str(inc_dir))
+    inc = merged["incident"]
+    assert inc["world"] == "pmworld"
+    assert sorted(inc["ranks"]) == ["0", "1"]
+    assert inc["ranks"]["1"]["error"] == "test incident"
+
+
+def test_postmortem_noop_without_dir(tmp_path):
+    """No TDR_POSTMORTEM_DIR: rebuild writes nothing and counts
+    nothing (the knob gates the whole feature)."""
+    os.environ.pop("TDR_POSTMORTEM_DIR", None)
+    worlds = local_worlds(2, free_port())
+    try:
+        for w in worlds:
+            w._write_postmortem("x")
+            assert w._postmortems == 0
+    finally:
+        for w in worlds:
+            w.close()
+
+
+# ----------------------------------------------------- taint + lanes
+
+def test_overlap_fraction_taints_on_drops():
+    from rocnrdma_tpu.telemetry import recorder
+
+    recorder._warned_tainted = False
+    with pytest.warns(RuntimeWarning, match="dropped 5 events"):
+        r = telemetry.overlap_fraction(events=[], dropped=5)
+    assert r["tainted"] is True and r["dropped"] == 5
+    r = telemetry.overlap_fraction(events=[], dropped=0)
+    assert r["tainted"] is False and r["dropped"] == 0
+
+
+def test_perfetto_tier_lane_labels():
+    """Hier tier-ring QP lanes label with tier=intra|inter and the
+    tier world's name (satellite: a hier trace must be readable
+    without guessing which qpN is the delegate ring)."""
+    from rocnrdma_tpu.telemetry.perfetto import export_trace
+
+    events = [
+        TelEvent(ts_ns=1000, name="world.up", source="python",
+                 fields={"world_name": "w.intra", "rank": 0, "world": 2,
+                         "tel_left": [21], "tel_right": [22]}),
+        TelEvent(ts_ns=1001, name="world.up", source="python",
+                 fields={"world_name": "w.x0", "rank": 0, "world": 2,
+                         "tel_left": [31], "tel_right": [32]}),
+        TelEvent(ts_ns=2000, name="post_send", engine=1, qp=22, id=1,
+                 arg=64, coll=7),
+        TelEvent(ts_ns=2100, name="post_send", engine=1, qp=32, id=1,
+                 arg=64, coll=7),
+    ]
+    doc = export_trace(events=events, include_python=True)
+    thread_names = {ev["tid"]: ev["args"]["name"]
+                    for ev in doc["traceEvents"]
+                    if ev.get("ph") == "M"
+                    and ev.get("name") == "thread_name"
+                    and ev.get("pid") == 1}
+    assert "tier=intra" in thread_names[22]
+    assert "w.intra" in thread_names[22]
+    assert "tier=inter" in thread_names[32]
+    assert "w.x0" in thread_names[32]
+    # coll rides into the instant's args (the join key in the UI).
+    insts = [ev for ev in doc["traceEvents"]
+             if ev.get("name") == "post_send"]
+    assert all(ev["args"]["coll"] == 7 for ev in insts)
+
+
+def test_tdr_top_fleet_view_renders_metrics():
+    """tdr_top --connect's parser + frame over a synthetic /metrics
+    exposition: per-world header, per-rank clock offsets, and the
+    taint flag on nonzero drops."""
+    import tdr_top
+
+    text = "\n".join([
+        "# tdr coordinator metrics v1",
+        'tdr_ctl_generation{world="train"} 3',
+        'tdr_ctl_epoch{world="train"} 5',
+        'tdr_ctl_size{world="train"} 2',
+        'tdr_ctl_members{world="train"} 2',
+        'tdr_ctl_rebuilds_total{world="train"} 1',
+        'tdr_postmortems_total{world="train"} 4',
+        'tdr_retransmit_rate{world="train"} 0.0125',
+        'tdr_chunk_lat_us{world="train",quantile="0.99"} 1234',
+        'tdr_clock_offset_us{world="train",rank="0"} -12.5',
+        'tdr_clock_offset_us{world="train",rank="1"} 40',
+        'tdr_clock_rtt_us{world="train",rank="0"} 300',
+        'tdr_clock_rtt_us{world="train",rank="1"} 500',
+        'tdr_telemetry_dropped_total{world="train",rank="1"} 9',
+    ])
+    frame = tdr_top.render_fleet(text)
+    assert "world train: gen=3 epoch=5 members=2/2" in frame
+    assert "rebuilds=1 postmortems=4" in frame
+    assert "retransmit_rate=0.0125" in frame and "chunk_p99_us=1234" in frame
+    assert "rank 0: clock_offset=-12.5us (rtt 300.0us) dropped=0" in frame
+    assert "rank 1: clock_offset=+40.0us" in frame
+    assert "dropped=9  TAINTED" in frame
+
+
+def test_explain_synthetic_straggler_and_phases():
+    """analyze_segments on a hand-built two-rank segment pair: the
+    late-arriving rank is the straggler, phase decomposition sums to
+    the observed span, and the tx->rx lane match yields a link."""
+    from rocnrdma_tpu.telemetry.recorder import events_to_wire
+    from tdr_explain import analyze_segments
+
+    MS = 1_000_000
+
+    def world_up(rank, left, right):
+        return TelEvent(ts_ns=0, name="world.up", source="python",
+                        fields={"world_name": "syn", "rank": rank,
+                                "world": 2, "tel_left": [left],
+                                "tel_right": [right]})
+
+    # rank 0 lanes: left 11 / right 12; rank 1: left 21 / right 22.
+    # Connection pairing: r0.right(12) -> r1.left(21).
+    r0 = [
+        world_up(0, 11, 12),
+        TelEvent(ts_ns=1 * MS, name="ring_begin", engine=1, id=1,
+                 arg=4096, coll=5),
+        TelEvent(ts_ns=2 * MS, name="post_send", engine=1, qp=12,
+                 id=1, arg=4096, coll=5),
+        TelEvent(ts_ns=3 * MS, name="wire_tx", engine=1, qp=12, id=1,
+                 arg=4096, coll=5),
+        TelEvent(ts_ns=9 * MS, name="wc", engine=1, qp=12, id=1,
+                 arg=0, coll=5),
+        TelEvent(ts_ns=10 * MS, name="ring_end", engine=1, id=1,
+                 arg=0, coll=5),
+    ]
+    r1 = [
+        world_up(1, 21, 22),
+        TelEvent(ts_ns=6 * MS, name="ring_begin", engine=2, id=1,
+                 arg=4096, coll=5),
+        TelEvent(ts_ns=7 * MS, name="wire_rx", engine=2, qp=21, id=1,
+                 arg=4096, coll=5),
+        TelEvent(ts_ns=8 * MS, name="land", engine=2, qp=21, id=1,
+                 arg=4096, coll=5),
+        TelEvent(ts_ns=10 * MS, name="ring_end", engine=2, id=1,
+                 arg=0, coll=5),
+    ]
+    segments = {
+        "0": {"events": events_to_wire(r0), "clock_offset_ns": 0,
+              "dropped": 0},
+        "1": {"events": events_to_wire(r1), "clock_offset_ns": 0,
+              "dropped": 7},
+    }
+    a = analyze_segments(segments)
+    assert a["joinable_collectives"] == 1
+    assert a["straggler"]["rank"] == 1  # arrived 5ms late
+    c = a["collectives"][0]
+    assert c["straggler"] == 1
+    # Phase decomposition sums to each rank's begin->end span.
+    d0 = c["ranks"]["0"]
+    assert d0["wall_s"] == pytest.approx(9e-3)
+    assert sum(d0["phases_s"].values()) == pytest.approx(9e-3)
+    assert d0["phases_s"]["post"] == pytest.approx(1e-3)
+    # The link r0->r1 was matched by (lane pair, seq) and carries the
+    # 4 KiB frame over tx(3ms)->rx(7ms).
+    assert len(a["links"]) == 1
+    ln = a["links"][0]
+    assert (ln["src"], ln["dst"]) == (0, 1)
+    assert ln["bytes"] == 4096
+    assert ln["seconds"] == pytest.approx(4e-3)
+    # The dropped ring taints rank 1.
+    assert a["tainted_ranks"] == {"1": 7}

@@ -15,6 +15,12 @@ Two ways to attach:
   **--demo** — run a world-2 emu allreduce loop IN this process with
   telemetry on and watch it live (the zero-setup showcase).
 
+  **--connect HOST:PORT** — watch a COORDINATOR's /metrics: one
+  terminal renders every named world's generation/epoch/membership,
+  rebuild and retransmit counters, per-rank clock offsets, telemetry
+  drops, and postmortem counts — the whole fleet beside (or instead
+  of) the local-ring view.
+
   ``--once`` prints a single frame and exits (scripting / tests).
 """
 import argparse
@@ -143,6 +149,103 @@ def render(snap: dict, chan_lats: "ChannelLats" = None) -> str:
     return "\n".join(lines)
 
 
+def parse_metrics(text: str) -> dict:
+    """Parse a Prometheus text exposition into
+    {metric: [(labels-dict, value)]} — just enough structure for the
+    fleet frame (no dependency on a client library)."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, rest = line.partition("{")
+        labels = {}
+        if rest:
+            lab, _, val = rest.rpartition("} ")
+            for part in lab.split(","):
+                k, _, v = part.partition("=")
+                if k:
+                    labels[k] = v.strip('"')
+        else:
+            name, _, val = line.partition(" ")
+        try:
+            out.setdefault(name.strip(), []).append(
+                (labels, float(val)))
+        except ValueError:
+            continue
+    return out
+
+
+def _metric(m: dict, name: str, world: str, rank: str = None) -> float:
+    for labels, val in m.get(name, ()):
+        if labels.get("world") != world:
+            continue
+        if rank is not None and labels.get("rank") != rank:
+            continue
+        if rank is None and "rank" in labels:
+            continue
+        return val
+    return 0.0
+
+
+def render_fleet(metrics_text: str) -> str:
+    """The --connect frame: one block per named world."""
+    m = parse_metrics(metrics_text)
+    lines = ["tdr_top — fleet view (coordinator /metrics)", ""]
+    worlds = sorted({labels.get("world")
+                     for labels, _ in m.get("tdr_ctl_generation", ())
+                     if labels.get("world")})
+    if not worlds:
+        return lines[0] + "\n\n(no worlds registered)"
+    for w in worlds:
+        size = int(_metric(m, "tdr_ctl_size", w))
+        lines.append(
+            f"world {w}: gen={int(_metric(m, 'tdr_ctl_generation', w))} "
+            f"epoch={int(_metric(m, 'tdr_ctl_epoch', w))} "
+            f"members={int(_metric(m, 'tdr_ctl_members', w))}/{size} "
+            f"rebuilds={int(_metric(m, 'tdr_ctl_rebuilds_total', w))} "
+            f"postmortems={int(_metric(m, 'tdr_postmortems_total', w))}")
+        lines.append(
+            f"  retransmit_rate={_metric(m, 'tdr_retransmit_rate', w):.4g}"
+            f"  chunk_p99_us="
+            f"{int(_metric_q(m, 'tdr_chunk_lat_us', w, '0.99'))}")
+        # Per-rank rows: clock offset (the fleet-merge alignment), its
+        # RTT bound, and telemetry drops (the taint signal).
+        ranks = sorted({labels.get("rank")
+                        for labels, _ in m.get("tdr_clock_offset_us", ())
+                        if labels.get("world") == w}, key=_rank_key)
+        for r in ranks:
+            off = _metric(m, "tdr_clock_offset_us", w, r)
+            rtt = _metric(m, "tdr_clock_rtt_us", w, r)
+            drops = _metric(m, "tdr_telemetry_dropped_total", w, r)
+            taint = "  TAINTED" if drops else ""
+            lines.append(f"  rank {r}: clock_offset={off:+.1f}us "
+                         f"(rtt {rtt:.1f}us) "
+                         f"dropped={int(drops)}{taint}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _rank_key(r):
+    try:
+        return (0, int(r))
+    except (TypeError, ValueError):
+        return (1, str(r))
+
+
+def _metric_q(m: dict, name: str, world: str, q: str) -> float:
+    for labels, val in m.get(name, ()):
+        if labels.get("world") == world and labels.get("quantile") == q:
+            return val
+    return 0.0
+
+
+def fetch_metrics(address: str) -> str:
+    from rocnrdma_tpu.control.client import ControlClient
+
+    return ControlClient(address).metrics()
+
+
 def demo_traffic(stop: threading.Event) -> None:
     """Background world-2 allreduce loop feeding the live view."""
     import socket
@@ -178,6 +281,10 @@ def main(argv=None) -> int:
                          "telemetry.start_snapshot_writer()")
     ap.add_argument("--demo", action="store_true",
                     help="drive an in-process world-2 allreduce loop")
+    ap.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="watch a coordinator's /metrics (fleet view: "
+                         "per-world generation, retransmit rate, clock "
+                         "offsets, postmortems)")
     ap.add_argument("--interval", type=float, default=1.0)
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit")
@@ -199,19 +306,31 @@ def main(argv=None) -> int:
     chan_lats = ChannelLats()
 
     def frame() -> str:
+        fleet = ""
+        if args.connect:
+            try:
+                fleet = render_fleet(fetch_metrics(args.connect))
+            except Exception as e:
+                fleet = (f"tdr_top — fleet view\n\ncoordinator "
+                         f"{args.connect} unreachable: {e}")
+            # --connect alone renders the fleet only; combined with
+            # --file/--demo the local view follows below.
+            if not args.file and not args.demo:
+                return fleet
+            fleet += "\n" + "=" * 64 + "\n"
         if args.file:
             try:
                 with open(args.file) as f:
-                    return render(json.load(f))
+                    return fleet + render(json.load(f))
             except FileNotFoundError:
-                return f"waiting for snapshot file {args.file} ..."
+                return fleet + f"waiting for snapshot file {args.file} ..."
             except json.JSONDecodeError:
-                return f"snapshot {args.file} mid-write, retrying ..."
+                return fleet + f"snapshot {args.file} mid-write, retrying ..."
         from rocnrdma_tpu import telemetry
 
         if telemetry.enabled():
             chan_lats.feed(telemetry.drain())
-        return render(telemetry.snapshot(), chan_lats)
+        return fleet + render(telemetry.snapshot(), chan_lats)
 
     try:
         if args.once:
