@@ -154,6 +154,23 @@ def resolve_topology(world: int, rank: int,
     return TopologyMap(keys, rank)
 
 
+def fallback_reason(topo: Optional[TopologyMap]) -> str:
+    """Why a RESOLVED multi-host topology cannot carry the
+    hierarchical schedule — '' when it can, or when there is nothing
+    to fall back FROM (no topology / a single host is flat by design,
+    not by degradation). Non-empty exactly for the shapes a fleet
+    operator would expect to run hier and silently doesn't: uneven
+    host groups (the remainder case) and singleton groups. The string
+    is deterministic from the key list alone, so it is digest-safe
+    (every rank derives the identical note)."""
+    if topo is None or topo.hierarchical or topo.n_hosts < 2:
+        return ""
+    if not topo.uniform:
+        sizes = "x".join(str(len(topo.groups[h])) for h in topo.hosts)
+        return f"nonuniform:h{topo.n_hosts}:{sizes}"
+    return f"singleton:h{topo.n_hosts}"
+
+
 def algo_mode() -> str:
     """TDR_ALGO as the selector parses it (default 'auto'); invalid
     values raise rather than silently running a different schedule

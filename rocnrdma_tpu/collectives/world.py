@@ -70,6 +70,7 @@ import numpy as np
 
 from rocnrdma_tpu.collectives.topology import (TopologyMap, algo_stamp,
                                                choose_algo,
+                                               fallback_reason,
                                                resolve_topology)
 from rocnrdma_tpu.transport.engine import (Engine, QueuePair, Ring, RED_SUM,
                                            RingOp, TransportError,
@@ -370,6 +371,9 @@ class RingWorld:
         qp_budget: Optional[int] = None,
         topology=None,  # host-key list, None (env/view), or "flat"
         tier: str = "auto",  # "stream" pins connections off the CMA tier
+        resizable: bool = False,  # opt into coordinator RESIZE
+        max_size: int = 0,        # grow ceiling (0 = unbounded)
+        weight: float = 1.0,      # fair-share weight for the QP pool
     ):
         if world < 2:
             raise ValueError("RingWorld needs world >= 2")
@@ -428,6 +432,26 @@ class RingWorld:
         self._ctl_epoch = 0                  # membership view counter
         self._ctl_lease_ms = 5000
         self._hb = None                      # background lease renewal
+        # ---- Elastic membership (world RESIZE) ----
+        # resizable opts this world into coordinator-arbitrated
+        # shrink-to-survivors / grow-on-join; the coordinator's resize
+        # counter rides the view and (when nonzero) the schedule
+        # digest, so ranks disagreeing on the membership SHAPE fail
+        # the first collective fast. _resize_pending is raised by the
+        # heartbeat's resize hint: the next collective fails RETRYABLE
+        # so the elastic ladder re-rendezvouses at a collective
+        # boundary, where the coordinator cuts the new-size view.
+        self.resizable = bool(resizable)
+        self.max_size = int(max_size)
+        self.weight = float(weight)
+        self._ctl_resizes = 0
+        self._resize_pending = False
+        # QP appetite this incarnation reserved at bring-up (flat ring
+        # + hierarchical tier rings), heartbeat-pushed so the
+        # coordinator serves tdr_ctl_qp_reserved{world=}.
+        self._qp_reserved = 0
+        # Warn-once latch for the hier->flat topology fallback.
+        self._fallback_warned = False
         # Per-channel neighbor QPs; left_qp/right_qp alias channel 0
         # (the digest exchange and capability probes ride channel 0).
         self.left_qps: List[QueuePair] = []
@@ -542,17 +566,59 @@ class RingWorld:
         if arbitrated:
             # The coordinator's rendezvous barrier replaces the
             # per-rank generation guesswork: every rank of this
-            # incarnation receives the SAME membership view here.
+            # incarnation receives the SAME membership view here (and,
+            # on a RESIZE, the new world size and this rank's repacked
+            # position).
             self._ctl_rendezvous(timeout_ms)
+        if self._channels_auto:
+            # Re-derive the per-host channel cap from THIS
+            # incarnation's membership: a RESIZE changes the peer
+            # list, and with it the local-rank count the cap divides
+            # the core budget by.
+            self.channels = auto_channel_cap(self.peers, self.rank)
+        # Topology map for the hierarchical schedule: explicit param >
+        # TDR_TOPOLOGY > the coordinator view's host keys. Resolved
+        # per incarnation (an arbitrated rebuild or RESIZE may release
+        # different membership — a shrink that restores uniform groups
+        # re-enables hier here); tiers themselves pass topology="flat"
+        # and never recurse. A non-hierarchical map (one host,
+        # singleton groups, uneven groups) still resolves — the
+        # selector just never picks hier for it — and the multi-host
+        # shapes that LOOK hierarchical but cannot carry the schedule
+        # get a warn-once fallback counter + digest note below.
+        if self._topology_arg == "flat":
+            self.topology = None
+        else:
+            self.topology = resolve_topology(
+                self.world, self.rank, explicit=self._topology_arg,
+                view_keys=self._ctl_host_keys)
+        fb = fallback_reason(self.topology)
+        if fb and not self._fallback_warned:
+            self._fallback_warned = True
+            trace.add("algo.fallback", 1)
+            trace.event("algo.fallback", rank=self.rank,
+                        world_name=self.world_name, why=fb)
         nchan = self.channels
-        # Per-world QP budget, enforced at bring-up: this world needs
-        # 2 * channels QPs (one accept + one dial per channel). An
-        # over-budget world must die HERE, before it consumes a
-        # co-tenant world's native QP headroom or its peer's accept.
-        if self.qp_budget is not None and 2 * nchan > self.qp_budget:
+        # Per-world QP budget, enforced at bring-up against the FULL
+        # per-incarnation appetite: the flat ring needs 2 * channels
+        # QPs (one accept + one dial per channel), and a hierarchical
+        # world's intra + delegate tier rings each add 2 * tier
+        # channels more. Reserving only the flat appetite would let a
+        # hier world pass admission and then blow the engine budget
+        # mid-collective when the tiers come up lazily. An over-budget
+        # world must die HERE, before it consumes a co-tenant world's
+        # native QP headroom or its peer's accept.
+        reserved = 2 * nchan
+        if self.topology is not None and self.topology.hierarchical:
+            reserved += 4 * self._tier_channels()
+        self._qp_reserved = reserved
+        if self.qp_budget is not None and reserved > self.qp_budget:
             raise TransportError(
-                f"world {self.world_name!r} needs {2 * nchan} QPs "
-                f"({nchan} channels) but its qp_budget is "
+                f"world {self.world_name!r} needs {reserved} QPs "
+                f"({nchan} channels"
+                + (f" + two tier rings of {self._tier_channels()}"
+                   if reserved > 2 * nchan else "")
+                + f") but its qp_budget is "
                 f"{self.qp_budget}; lower TDR_RING_CHANNELS or raise "
                 "the budget", retryable=False)
         rank, world = self.rank, self.world
@@ -646,19 +712,6 @@ class RingWorld:
         except BaseException:
             self._teardown()
             raise
-        # Topology map for the hierarchical schedule: explicit param >
-        # TDR_TOPOLOGY > the coordinator view's host keys. Resolved
-        # per incarnation (an arbitrated rebuild may release different
-        # membership); tiers themselves pass topology="flat" and never
-        # recurse. A non-hierarchical map (one host, singleton groups,
-        # uneven groups) still resolves — the selector just never
-        # picks hier for it.
-        if self._topology_arg == "flat":
-            self.topology = None
-        else:
-            self.topology = resolve_topology(
-                self.world, self.rank, explicit=self._topology_arg,
-                view_keys=self._ctl_host_keys)
         if arbitrated:
             self._ensure_heartbeat()
         # tel_engine ties this rank to its native flight-recorder
@@ -726,7 +779,10 @@ class RingWorld:
                 view = self.controller.join(self.world_name, self.world,
                                             rank=self.rank, host=host,
                                             host_key=key,
-                                            timeout_s=timeout_s)
+                                            timeout_s=timeout_s,
+                                            resizable=self.resizable,
+                                            max_size=self.max_size,
+                                            weight=self.weight)
                 if not view.get("ok"):
                     raise TransportError(
                         f"control join failed on rank {self.rank}: "
@@ -735,8 +791,20 @@ class RingWorld:
             raise TransportError(str(e), retryable=True) from e
         # Adopt the coordinator-ASSIGNED ring position: rank=-1 asks
         # for the lowest free slot, and the whole port/neighbor scheme
-        # below keys off self.rank.
+        # below keys off self.rank. A RESIZE view also moves the world
+        # SIZE — a shrink repacked the survivors contiguously, a grow
+        # admitted a parked joiner past the old end — so the size is
+        # adopted with the same authority as the rank.
         self.rank = int(view.get("rank", self.rank))
+        self.world = int(view.get("world_size", self.world))
+        old_resizes = self._ctl_resizes
+        self._ctl_resizes = int(view.get("resizes", 0))
+        if self._ctl_resizes != old_resizes:
+            trace.add("ctl.resize_adopted", 1)
+            trace.event("ctl.resize_adopted", rank=self.rank,
+                        world_name=self.world_name,
+                        world=self.world, resizes=self._ctl_resizes)
+        self._resize_pending = False
         self._ctl_inc = int(view["incarnation"])
         self.generation = int(view["generation"])
         self._ctl_epoch = int(view["epoch"])
@@ -785,7 +853,10 @@ class RingWorld:
             w = wself()
             if w is None:
                 return None  # world collected: heartbeat thread exits
-            return (w._ctl_inc, w.generation)
+            # Rank rides along: a RESIZE moves this member's ring
+            # position under the SAME incarnation, and the heartbeat
+            # must follow it (the old rank's pushes are superseded).
+            return (w._ctl_inc, w.generation, w.rank)
 
         def _counters():
             from rocnrdma_tpu.transport.engine import native_counters
@@ -832,11 +903,31 @@ class RingWorld:
             w = wself()
             return 0 if w is None else w._postmortems
 
+        def _notify(resp):
+            # The coordinator's RESIZE hint: membership no longer
+            # matches this incarnation's shape (a grow joiner parked,
+            # or a slot died on a resizable world). Flag it so the
+            # NEXT collective fails retryably at its entry boundary
+            # and the elastic ladder re-parks for the new-size view —
+            # heartbeats are how a healthy member learns about a
+            # resize that broke nothing it can observe on the wire.
+            w = wself()
+            if w is not None and resp.get("resize_pending"):
+                w._resize_pending = True
+
+        def _extras():
+            # Bring-up QP reservation, pushed so the coordinator can
+            # serve tdr_ctl_qp_reserved{world=} (reserved appetite vs
+            # the fair share it granted).
+            w = wself()
+            return {} if w is None else {"qp_reserved": w._qp_reserved}
+
         self._hb = self.controller.start_heartbeat(
             self.world_name, self.rank, state_fn=_state,
             interval_s=max(0.2, self._ctl_lease_ms / 3000.0),
             counters_fn=_counters, hists_fn=_hists,
-            trace_fn=_trace_segment, postmortems_fn=_postmortems)
+            trace_fn=_trace_segment, postmortems_fn=_postmortems,
+            notify_fn=_notify, extras_fn=_extras)
 
     @property
     def control_stamp(self) -> str:
@@ -844,11 +935,19 @@ class RingWorld:
         generation and membership epoch. Empty (legacy digests are
         preserved byte-for-byte) without a controller; with one, two
         ranks acting on different membership views fail the first
-        collective's digest exchange instead of desynchronizing."""
+        collective's digest exchange instead of desynchronizing. A
+        RESIZE stamps its count in too — generation alone also moves,
+        but the resize count makes "same generation, different world
+        shape" (a restore racing a resize) structurally impossible to
+        agree on. Worlds that never resized keep the legacy stamp
+        byte-for-byte."""
         if self.controller is None:
             return ""
-        return (f"ctl={self.world_name}:g{self.generation}"
-                f":e{self._ctl_epoch}")
+        stamp = (f"ctl={self.world_name}:g{self.generation}"
+                 f":e{self._ctl_epoch}")
+        if self._ctl_resizes:
+            stamp += f":r{self._ctl_resizes}"
+        return stamp
 
     def _ensure_digest_bufs(self) -> None:
         if self._dg_smr is not None:
@@ -883,7 +982,16 @@ class RingWorld:
         """The ring, or a RETRYABLE error when this incarnation is
         torn down (a flapped rank's collectives between teardown and
         rebuild must surface as elastic-recoverable, not as an
-        AttributeError the trainer cannot classify)."""
+        AttributeError the trainer cannot classify). A pending world
+        RESIZE surfaces here too: the coordinator cuts the new-size
+        view at a COLLECTIVE BOUNDARY, so a member that learned of one
+        via its heartbeat must fail the next collective retryably and
+        re-park rather than run it at a shape the fleet is leaving."""
+        if self._resize_pending:
+            raise TransportError(
+                f"world RESIZE pending on rank {self.rank} (membership "
+                "no longer matches this incarnation's shape); "
+                "rebuild() required", retryable=True)
         ring = self.ring
         if ring is None:
             raise TransportError(
@@ -1020,10 +1128,18 @@ class RingWorld:
         mode. Empty for flat worlds (legacy digests byte-identical);
         with it, two ranks grouping the world differently — or
         switching algorithms at different sizes — fail the first
-        collective's digest exchange instead of desynchronizing."""
+        collective's digest exchange instead of desynchronizing. A
+        multi-host topology that RESOLVED but cannot carry the
+        hierarchical schedule (non-uniform host groups after an uneven
+        shrink, singleton groups) stamps its fallback reason instead:
+        two ranks disagreeing on WHY the world fell back to flat is
+        the same split-brain as disagreeing on the grouping."""
         topo = self.topology
-        if topo is None or not topo.hierarchical:
+        if topo is None:
             return ""
+        if not topo.hierarchical:
+            fb = fallback_reason(topo)
+            return f"topo=fallback:{fb}" if fb else ""
         return f"{topo.stamp()} {algo_stamp(topo)}"
 
     def _algo_for(self, nbytes: int, algo: Optional[str]) -> str:

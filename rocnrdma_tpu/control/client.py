@@ -122,7 +122,9 @@ class ControlClient:
     def join(self, world: str, size: int, rank: int = -1,
              host: str = "127.0.0.1",
              host_key: Optional[str] = None,
-             timeout_s: Optional[float] = None) -> Dict[str, Any]:
+             timeout_s: Optional[float] = None,
+             resizable: bool = False, max_size: int = 0,
+             weight: float = 1.0) -> Dict[str, Any]:
         """``host_key`` is the member's TOPOLOGY key (which physical
         host it sits on) — distinct from ``host``, the address peers
         dial, and deliberately NOT defaulted from it: inferring
@@ -132,11 +134,20 @@ class ControlClient:
         the coordinator releases a keyless view the member side
         ignores. The coordinator releases every slot's key in the view
         (``host_keys``), which is how arbitrated worlds agree on the
-        hierarchical grouping without a per-rank env."""
+        hierarchical grouping without a per-rank env.
+
+        ``resizable`` opts the world (sticky, first join wins) into
+        coordinator-arbitrated RESIZE: shrink-to-survivors on a lease
+        expiry/leave, grow-on-join when full (``max_size`` caps the
+        growth; 0 = unbounded). ``weight`` is the world's fair-share
+        weight when the coordinator divides its engine QP pool."""
         budget = self.timeout_s if timeout_s is None else float(timeout_s)
         return self.request("join", timeout_s=budget, world=world,
                             size=int(size), rank=int(rank), host=host,
-                            host_key=host_key)
+                            host_key=host_key,
+                            resizable=bool(resizable),
+                            max_size=int(max_size),
+                            weight=float(weight))
 
     def sync(self, world: str, rank: int, incarnation: int,
              timeout_s: Optional[float] = None) -> Dict[str, Any]:
@@ -210,17 +221,26 @@ class ControlClient:
                         counters_fn: Optional[Callable[[], Dict]] = None,
                         hists_fn: Optional[Callable[[], Dict]] = None,
                         trace_fn: Optional[Callable[[int], Dict]] = None,
-                        postmortems_fn: Optional[Callable[[], int]] = None
+                        postmortems_fn: Optional[Callable[[], int]] = None,
+                        notify_fn: Optional[Callable[[Dict], None]] = None,
+                        extras_fn: Optional[Callable[[], Dict]] = None
                         ) -> "Heartbeat":
         """Renew this member's lease from a daemon thread every
         ``interval_s``, pushing counter/histogram snapshots for the
         coordinator's /metrics aggregation. ``state_fn`` returns the
-        member's CURRENT (incarnation, generation) — it changes across
-        rejoins, so the thread reads it per beat. ``trace_fn(max_events)``
+        member's CURRENT (incarnation, generation) or (incarnation,
+        generation, rank) — incarnation AND rank change across
+        rejoins/RESIZEs, so the thread reads it per beat (a 2-tuple
+        keeps the construction-time rank). ``trace_fn(max_events)``
         serves ``collect_trace`` pulls (returns {"events": wire list,
-        "dropped": int}); ``postmortems_fn`` reports bundles written."""
+        "dropped": int}); ``postmortems_fn`` reports bundles written;
+        ``notify_fn(resp)`` sees every accepted heartbeat response
+        (how a member learns ``resize_pending``); ``extras_fn()``
+        returns additional scalar riders merged into every beat (how a
+        member pushes its bring-up ``qp_reserved``)."""
         return Heartbeat(self, world, rank, state_fn, interval_s,
-                         counters_fn, hists_fn, trace_fn, postmortems_fn)
+                         counters_fn, hists_fn, trace_fn, postmortems_fn,
+                         notify_fn, extras_fn)
 
 
 class Heartbeat:
@@ -229,11 +249,23 @@ class Heartbeat:
                  counters_fn: Optional[Callable[[], Dict]] = None,
                  hists_fn: Optional[Callable[[], Dict]] = None,
                  trace_fn: Optional[Callable[[int], Dict]] = None,
-                 postmortems_fn: Optional[Callable[[], int]] = None):
+                 postmortems_fn: Optional[Callable[[], int]] = None,
+                 notify_fn: Optional[Callable[[Dict], None]] = None,
+                 extras_fn: Optional[Callable[[], Dict]] = None):
         self._client = client
         self._world = world
         self._rank = rank
         self._state_fn = state_fn
+        self._notify_fn = notify_fn
+        self._extras_fn = extras_fn
+        # (incarnation, rank) the coordinator declared superseded: a
+        # member that left, was lease-expired, or was resized out must
+        # STOP pushing counters under that identity — the coordinator
+        # rejects the pushes, and retrying them forever is the
+        # heartbeat-after-leave leak. Beats resume the moment state_fn
+        # reports a different identity (a rejoin's new incarnation, or
+        # a RESIZE's new rank for the same incarnation).
+        self._dead_key: Optional[tuple] = None
         self._interval = max(0.05, float(interval_s))
         self._counters_fn = counters_fn
         self._hists_fn = hists_fn
@@ -262,9 +294,14 @@ class Heartbeat:
         state = self._state_fn()
         if state is None:
             return False
-        inc, gen = state
+        if len(state) >= 3:
+            inc, gen, rank = state[0], state[1], state[2]
+        else:
+            inc, gen, rank = state[0], state[1], self._rank
         if inc is None:
             return True  # between incarnations: nothing to renew
+        if (inc, rank) == self._dead_key:
+            return True  # superseded identity: push nothing under it
         counters = self._counters_fn() if self._counters_fn else None
         hists = self._hists_fn() if self._hists_fn else None
         extra: Dict[str, Any] = self.clock.state()
@@ -273,8 +310,13 @@ class Heartbeat:
                 extra["postmortems"] = int(self._postmortems_fn())
             except Exception:
                 pass
+        if self._extras_fn is not None:
+            try:
+                extra.update(self._extras_fn() or {})
+            except Exception:
+                pass  # a rider hook must never cost the lease renewal
         t0 = time.monotonic_ns()
-        resp = self._client.heartbeat(self._world, self._rank, inc, gen,
+        resp = self._client.heartbeat(self._world, rank, inc, gen,
                                       counters=counters, hists=hists,
                                       t0_ns=t0, **extra)
         t3 = time.monotonic_ns()
@@ -285,17 +327,28 @@ class Heartbeat:
         except (KeyError, TypeError, ValueError):
             pass  # pre-clock coordinator: estimate just stays at 0
         if not resp.get("ok"):
+            if resp.get("error") == "superseded":
+                # The coordinator owns membership: this identity is
+                # dead there (left / lease-expired / resized out).
+                # Stop pushing under it — the next rejoin or RESIZE
+                # view changes what state_fn returns and beats resume.
+                self._dead_key = (inc, rank)
             trace.event("ctl.heartbeat_refused", world=self._world,
-                        rank=self._rank,
+                        rank=rank,
                         error=str(resp.get("error", ""))[:80])
             return True
+        if self._notify_fn is not None:
+            try:
+                self._notify_fn(resp)
+            except Exception:
+                pass  # a member-side hook must never kill the lease
         collect = resp.get("collect")
         if isinstance(collect, dict) and self._trace_fn is not None:
-            self._push_trace(collect, inc, gen)
+            self._push_trace(collect, inc, gen, rank)
         return True
 
     def _push_trace(self, collect: Dict[str, Any], inc: int,
-                    gen: int) -> None:
+                    gen: int, rank: Optional[int] = None) -> None:
         """Serve one collect_trace pull: drain a bounded local segment
         and push it under the request id. The drain runs ONCE per id
         (it is destructive); the push retries on ANY failure —
@@ -327,9 +380,11 @@ class Heartbeat:
                 self._trace_payloads.pop(
                     min(self._trace_payloads), None)
             self._trace_payloads[trace_id] = payload
+        if rank is None:
+            rank = self._rank
         try:
             resp = self._client.request(
-                "trace_push", world=self._world, rank=self._rank,
+                "trace_push", world=self._world, rank=int(rank),
                 incarnation=int(inc), generation=int(gen),
                 trace_id=trace_id, segment=payload)
         except ControlError:
@@ -339,7 +394,7 @@ class Heartbeat:
             self._trace_payloads.pop(trace_id, None)
             if resp.get("ok"):
                 trace.event("ctl.trace_push", world=self._world,
-                            rank=self._rank, trace_id=trace_id,
+                            rank=int(rank), trace_id=trace_id,
                             events=len(payload.get("events") or []))
         # Any other refusal (superseded member mid-rebuild): keep the
         # cache, retry under the next incarnation's heartbeat.

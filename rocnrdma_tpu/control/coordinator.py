@@ -45,8 +45,8 @@ members are one process each, where the sum is exact.
 
 from __future__ import annotations
 
-import itertools
 import json
+import os
 import socket
 import threading
 import time
@@ -68,7 +68,7 @@ class _Member:
     __slots__ = ("rank", "incarnation", "host", "host_key",
                  "lease_deadline", "alive", "waiting", "pending_view",
                  "counters", "hists", "wait_token", "clock_offset_ns",
-                 "clock_rtt_ns", "postmortems")
+                 "clock_rtt_ns", "postmortems", "qp_reserved", "hb_last")
 
     def __init__(self, rank: int, incarnation: int, host: str,
                  lease_deadline: float, host_key: Optional[str] = None):
@@ -104,12 +104,21 @@ class _Member:
         self.clock_offset_ns = 0
         self.clock_rtt_ns = 0
         self.postmortems = 0
+        # QP appetite this member reserved at bring-up (flat ring +
+        # hierarchical tier rings; heartbeat-pushed, served as
+        # tdr_ctl_qp_reserved{world=}).
+        self.qp_reserved = 0
+        # Last accepted heartbeat instant (monotonic) — the per-member
+        # state behind the optional heartbeat rate limit.
+        self.hb_last = 0.0
 
 
 class _World:
     __slots__ = ("name", "size", "base_port", "qp_budget", "generation",
                  "epoch", "members", "ever_ready", "rebuilds",
-                 "lease_expiries", "joins", "trace_req", "trace_seq")
+                 "lease_expiries", "joins", "trace_req", "trace_seq",
+                 "resizable", "max_size", "resizes", "weight",
+                 "qp_share", "admission_rejects", "hb_throttled")
 
     def __init__(self, name: str, size: int, base_port: int,
                  qp_budget: int):
@@ -124,6 +133,19 @@ class _World:
         self.rebuilds = 0
         self.lease_expiries = 0
         self.joins = 0
+        # ---- Elastic membership (RESIZE) ----
+        # Sticky opt-in (first join's ``resizable`` field): a lease
+        # expiry or leave after first-ready cuts a world_size-1 view
+        # to the survivors instead of waiting for a rejoin, and a
+        # joiner on a full world parks for a world_size+1 view.
+        self.resizable = False
+        self.max_size = 0  # grow ceiling (0 = unbounded)
+        self.resizes = 0
+        # ---- Admission control ----
+        self.weight = 1.0   # fair-share weight (first join's ``weight``)
+        self.qp_share = qp_budget  # computed fair share (gauge)
+        self.admission_rejects = 0
+        self.hb_throttled = 0
         # Pending collect_trace pull: {"id", "max_events", "segments":
         # {rank: segment}} — heartbeats see the flag and push; the
         # parked collector wakes when every live rank reported.
@@ -142,16 +164,51 @@ class Coordinator:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  lease_ms: int = 5000, port_base: int = 36000,
-                 port_stride: int = 64, qp_budget: int = 0):
+                 port_stride: int = 64, qp_budget: int = 0,
+                 qp_fair: bool = False, qp_floor: int = 0,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_interval_s: float = 2.0,
+                 restore: bool = False,
+                 hb_min_interval_ms: int = 0,
+                 scrape_min_interval_ms: int = 0,
+                 max_worlds: int = 0):
         self.host = host
         self.lease_ms = int(lease_ms)
         self.port_base = int(port_base)
         self.port_stride = int(port_stride)
         self.qp_budget = int(qp_budget)
+        # Admission control: with qp_fair, ``qp_budget`` is the TOTAL
+        # engine pool divided across named worlds by weight (floored
+        # at qp_floor); without it, every world gets the full budget
+        # (the pre-fair-share per-world semantics, default).
+        self.qp_fair = bool(qp_fair)
+        self.qp_floor = int(qp_floor)
+        self.max_worlds = int(max_worlds)
+        self._hb_min_s = max(0.0, int(hb_min_interval_ms) / 1000.0)
+        self._scrape_min_s = max(0.0,
+                                 int(scrape_min_interval_ms) / 1000.0)
+        self._last_scrape = 0.0
+        self._scrape_throttled = 0
+        # Coordinator redundancy: periodic full-state snapshots to
+        # snapshot_dir (TDR_CTL_SNAPSHOT_DIR env fallback); restore=True
+        # resumes arbitration from the latest one — members re-attach
+        # via heartbeat re-registration, no full re-rendezvous.
+        if snapshot_dir is None:
+            snapshot_dir = os.environ.get("TDR_CTL_SNAPSHOT_DIR") or None
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_interval_s = max(0.1, float(snapshot_interval_s))
+        self._last_snapshot = 0.0  # wall time of the last dump
+        self.failovers = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._worlds: Dict[str, _World] = {}
-        self._next_inc = itertools.count(1)
+        self._next_inc = 1
+        snap = self._load_snapshot(snapshot_dir) if restore else None
+        if snap is not None and port == 0:
+            # A restored coordinator must come back at the address the
+            # fleet already dials: adopt the snapshot's port unless the
+            # caller pinned one explicitly.
+            port = int(snap.get("port", 0))
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -159,6 +216,8 @@ class Coordinator:
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         self._threads: List[threading.Thread] = []
+        if snap is not None:
+            self._restore_state(snap)
 
     # ------------------------------------------------------- lifecycle
 
@@ -167,17 +226,31 @@ class Coordinator:
         return f"{self.host}:{self.port}"
 
     def start(self) -> "Coordinator":
-        for target, name in ((self._serve, "tdr-ctl-accept"),
-                             (self._sweep, "tdr-ctl-sweeper")):
+        workers = [(self._serve, "tdr-ctl-accept"),
+                   (self._sweep, "tdr-ctl-sweeper")]
+        if self.snapshot_dir:
+            workers.append((self._snapshots, "tdr-ctl-snapshot"))
+        for target, name in workers:
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
             self._threads.append(t)
         trace.event("ctl.coordinator", address=self.address,
-                    lease_ms=self.lease_ms)
+                    lease_ms=self.lease_ms, failovers=self.failovers)
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        # A blocked accept() pins the listen socket past close() (the
+        # in-flight syscall holds the file open), so the port would
+        # stay bound and a restore/standby rebind on the SAME address
+        # would EADDRINUSE. Poke the listener with a throwaway
+        # connection so the accept thread observes the stop flag.
+        try:
+            with socket.create_connection((self.host, self.port),
+                                          timeout=1):
+                pass
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -186,6 +259,13 @@ class Coordinator:
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=5)
+        if self.snapshot_dir:
+            # Final dump so a clean shutdown leaves a restorable image
+            # (a SIGKILL relies on the last periodic one instead).
+            try:
+                self.snapshot_now()
+            except OSError:
+                pass
 
     def __enter__(self):
         return self.start()
@@ -238,8 +318,23 @@ class Coordinator:
             if not h or h in (b"\r\n", b"\n"):
                 break
         if path.startswith("/metrics"):
-            body = self.render_metrics().encode()
-            status = "200 OK"
+            now = time.monotonic()
+            with self._lock:
+                throttled = (self._scrape_min_s > 0.0 and
+                             now - self._last_scrape < self._scrape_min_s)
+                if throttled:
+                    self._scrape_throttled += 1
+                else:
+                    self._last_scrape = now
+            if throttled:
+                # Admission control on the scrape path: a hot scraper
+                # gets a deterministic backoff, not the render cost.
+                body = (f"retry after "
+                        f"{self._scrape_min_s:.3f}s\n").encode()
+                status = "429 Too Many Requests"
+            else:
+                body = self.render_metrics().encode()
+                status = "200 OK"
         elif path.startswith("/healthz"):
             body = b"ok\n"
             status = "200 OK"
@@ -271,14 +366,75 @@ class Coordinator:
 
     # ----------------------------------------------------- arbitration
 
-    def _get_world(self, name: str, size: int) -> _World:
+    def _alloc_inc(self) -> int:
+        """Monotonic incarnation numbers (under the lock). A plain
+        counter rather than itertools so snapshots can persist it — a
+        restored coordinator must never re-issue a live incarnation."""
+        v = self._next_inc
+        self._next_inc += 1
+        return v
+
+    def _get_world(self, name: str, size: int,
+                   req: Optional[Dict[str, Any]] = None) -> _World:
         w = self._worlds.get(name)
         if w is None:
             base = self.port_base + len(self._worlds) * self.port_stride
             w = _World(name, size, base, self.qp_budget)
+            if req is not None:
+                # Sticky world-scoped knobs, set by the FIRST join:
+                # elastic opt-in, grow ceiling, fair-share weight.
+                w.resizable = bool(req.get("resizable"))
+                try:
+                    w.max_size = max(0, int(req.get("max_size") or 0))
+                except (TypeError, ValueError):
+                    pass
+                try:
+                    w.weight = max(0.0, float(req.get("weight", 1.0)))
+                except (TypeError, ValueError):
+                    pass
             self._worlds[name] = w
-            trace.event("ctl.world", world=name, size=size, base_port=base)
+            self._recompute_shares()
+            trace.event("ctl.world", world=name, size=size, base_port=base,
+                        resizable=int(w.resizable))
         return w
+
+    def _recompute_shares(self) -> None:
+        """Weighted fair-share division of the engine QP pool across
+        named worlds, with per-world floors. Without qp_fair every
+        world's share IS the per-world budget (stricter-wins at the
+        member keeps working unchanged); with it the total divides by
+        weight, and a new world's arrival re-divides — existing worlds
+        adopt their new share at the next view they park for."""
+        if not self.qp_fair or not self.qp_budget or not self._worlds:
+            for w in self._worlds.values():
+                w.qp_share = w.qp_budget
+            return
+        total_weight = sum(w.weight for w in self._worlds.values()) or 1.0
+        for w in self._worlds.values():
+            share = int(self.qp_budget * w.weight / total_weight)
+            w.qp_share = max(self.qp_floor, share)
+
+    def _apply_resize(self, w: _World) -> None:
+        """Cut the RESIZE: repack the parked survivors (and any parked
+        grow joiners) into contiguous ranks 0..n-1 ordered by their old
+        rank, drop dead members entirely (their superseded pushes are
+        rejected from here on, never re-adopted), and bump the
+        generation — the new size is a membership decision like any
+        other. Callers release the view immediately after, so the
+        resize and its first view are one atomic arbitration step."""
+        alive = sorted(w.alive_members(), key=lambda m: m.rank)
+        old_size, old_ranks = w.size, [m.rank for m in alive]
+        w.members = {}
+        for i, m in enumerate(alive):
+            m.rank = i
+            w.members[i] = m
+        w.size = len(alive)
+        w.resizes += 1
+        w.generation += 1
+        trace.add("ctl.resize", 1)
+        trace.event("ctl.resize", world=w.name, old_size=old_size,
+                    new_size=w.size, old_ranks=old_ranks,
+                    generation=w.generation, resizes=w.resizes)
 
     def _membership_changed(self, w: _World, why: str) -> None:
         """A slot's occupancy changed. Before the world ever became
@@ -296,8 +452,18 @@ class Coordinator:
         every one of them atomically (under the lock), so no two
         members can ever act on different views."""
         alive = w.alive_members()
-        if len(alive) != w.size or not all(m.waiting for m in alive):
+        if not alive or not all(m.waiting for m in alive):
             return
+        if {m.rank for m in alive} != set(range(w.size)):
+            # Membership does not match the nominal shape: dead slots
+            # (shrink candidates) or parked joiners beyond the size
+            # (grow candidates). A resizable, once-ready world cuts a
+            # RESIZE view to exactly the parked survivors; any other
+            # world keeps waiting for the missing slots to rejoin.
+            if not (w.resizable and w.ever_ready and len(alive) >= 2):
+                return
+            self._apply_resize(w)
+            alive = w.alive_members()
         w.epoch += 1
         if w.ever_ready:
             # Every re-release after the world first became ready IS a
@@ -313,8 +479,9 @@ class Coordinator:
             "epoch": w.epoch,
             "base_port": w.base_port,
             "world_size": w.size,
+            "resizes": w.resizes,
             "lease_ms": self.lease_ms,
-            "qp_budget": w.qp_budget,
+            "qp_budget": w.qp_share if self.qp_fair else w.qp_budget,
             "peers": [w.members[r].host for r in range(w.size)],
             # One topology key per slot (join-reported; None for
             # members that reported none): the member side feeds these
@@ -382,18 +549,37 @@ class Coordinator:
         if size < 2:
             return {"ok": False, "error": "world size must be >= 2"}
         with self._cv:
-            w = self._get_world(name, size)
-            if size != w.size:
+            if (self.max_worlds and req.get("world") not in self._worlds
+                    and len(self._worlds) >= self.max_worlds):
+                return self._admission_reject(None, "fleet full: world "
+                                              "quota exhausted")
+            w = self._get_world(name, size, req)
+            if size != w.size and not w.resizable:
                 return {"ok": False,
                         "error": f"world {name} has size {w.size}, "
                                  f"not {size}"}
+            grow = False
             if rank < 0:
                 free = [r for r in range(w.size)
                         if r not in w.members or not w.members[r].alive]
-                if not free:
-                    return {"ok": False, "error": "world full"}
-                rank = free[0]
-            if rank >= w.size:
+                if free:
+                    rank = free[0]
+                elif w.resizable and w.ever_ready and \
+                        (not w.max_size or
+                         len(w.alive_members()) < w.max_size):
+                    # Grow-on-join: the world is full of live members,
+                    # so this joiner parks on the slot past the end —
+                    # the RESIZE to world_size+1 cuts at the next
+                    # collective boundary, when every current member
+                    # has parked too.
+                    rank = max(w.members, default=w.size - 1) + 1
+                    grow = True
+                else:
+                    # Admission backpressure: a full fleet is a
+                    # RETRYABLE condition with a deterministic
+                    # retry-after, not a hard failure.
+                    return self._admission_reject(w, "fleet full")
+            if rank >= w.size and not grow:
                 return {"ok": False,
                         "error": f"rank {rank} out of range for size "
                                  f"{w.size}"}
@@ -405,8 +591,8 @@ class Coordinator:
                 prev.alive = False
                 self._membership_changed(w, "superseded")
             elif w.ever_ready:
-                self._membership_changed(w, "rejoin")
-            m = _Member(rank, next(self._next_inc), host,
+                self._membership_changed(w, "grow" if grow else "rejoin")
+            m = _Member(rank, self._alloc_inc(), host,
                         time.monotonic() + self.lease_ms / 1000.0,
                         host_key=req.get("host_key"))
             m.waiting = True
@@ -414,9 +600,28 @@ class Coordinator:
             w.joins += 1
             trace.event("ctl.join", world=name, rank=rank,
                         incarnation=m.incarnation,
-                        generation=w.generation)
+                        generation=w.generation, grow=int(grow))
             self._maybe_release(w)
             return self._await_view(w, m, timeout_s)
+
+    def _admission_reject(self, w: Optional[_World],
+                          why: str) -> Dict[str, Any]:
+        """The backpressure verdict: retryable, with a retry-after
+        that is a deterministic function of the lease and how many
+        rejects this world has already absorbed (so a thundering herd
+        spreads itself without coordination)."""
+        if w is not None:
+            w.admission_rejects += 1
+            rejects = w.admission_rejects
+        else:
+            rejects = 1
+        retry_after = round(
+            (self.lease_ms / 1000.0) * (1 + (rejects - 1) % 3), 3)
+        trace.add("ctl.admission_reject", 1)
+        trace.event("ctl.admission_reject", world=w.name if w else "",
+                    why=why, retry_after_s=retry_after)
+        return {"ok": False, "error": why, "retryable": True,
+                "retry_after_s": retry_after}
 
     def _op_sync(self, req: Dict[str, Any]) -> Dict[str, Any]:
         timeout_s = min(max(float(req.get("timeout_s", 60.0)), 0.0), 600.0)
@@ -465,7 +670,16 @@ class Coordinator:
             if err:
                 return err
             w, m = resolved
-            m.lease_deadline = time.monotonic() + self.lease_ms / 1000.0
+            now = time.monotonic()
+            m.lease_deadline = now + self.lease_ms / 1000.0
+            if self._hb_min_s > 0.0 and now - m.hb_last < self._hb_min_s:
+                # Rate-limited: the lease still renews (dropping THAT
+                # would turn a chatty member into a dead one), but the
+                # counter/histogram/clock processing is shed.
+                w.hb_throttled += 1
+                return {"ok": True, "generation": w.generation,
+                        "throttled": True}
+            m.hb_last = now
             counters = req.get("counters")
             if isinstance(counters, dict):
                 m.counters = {str(k): int(v) for k, v in counters.items()}
@@ -480,7 +694,8 @@ class Coordinator:
             # estimate and postmortem tally (gauges on /metrics).
             for attr, key in (("clock_offset_ns", "clock_offset_ns"),
                               ("clock_rtt_ns", "clock_rtt_ns"),
-                              ("postmortems", "postmortems")):
+                              ("postmortems", "postmortems"),
+                              ("qp_reserved", "qp_reserved")):
                 v = req.get(key)
                 if v is not None:
                     try:
@@ -489,6 +704,14 @@ class Coordinator:
                         pass
             resp = {"ok": True, "generation": w.generation,
                     "stale": int(req.get("generation", -1)) != w.generation}
+            # RESIZE hint: membership no longer matches the nominal
+            # shape (a grow joiner is parked, or a slot died on a
+            # resizable world) — the member should fail its next
+            # collective retryably and park, so the coordinator can
+            # cut the new-size view at a collective boundary.
+            if w.resizable and {mm.rank for mm in w.alive_members()} \
+                    != set(range(w.size)):
+                resp["resize_pending"] = True
             # Pending trace pull this member has not served yet: flag
             # it so the member's heartbeat thread drains and pushes.
             tr = w.trace_req
@@ -588,6 +811,10 @@ class Coordinator:
             m.alive = False
             trace.event("ctl.leave", world=w.name, rank=m.rank)
             self._membership_changed(w, "leave")
+            # Survivors may ALREADY be parked (they saw the leaver's
+            # QPs close before the leave arrived): a resizable world
+            # must cut its shrink view now, not wait for a rejoin.
+            self._maybe_release(w)
             self._cv.notify_all()
             return {"ok": True, "generation": w.generation}
 
@@ -611,7 +838,151 @@ class Coordinator:
                                     rank=m.rank,
                                     incarnation=m.incarnation)
                         self._membership_changed(w, "lease")
+                        # The expiry may complete a shrink: survivors
+                        # parked waiting for this verdict get their
+                        # world_size-1 view here instead of timing out.
+                        self._maybe_release(w)
                         self._cv.notify_all()
+
+    # ------------------------------------------------------- snapshots
+
+    SNAPSHOT_FILE = "coordinator.json"
+
+    @classmethod
+    def _load_snapshot(cls, snapshot_dir: Optional[str]
+                       ) -> Optional[Dict[str, Any]]:
+        if not snapshot_dir:
+            return None
+        path = os.path.join(snapshot_dir, cls.SNAPSHOT_FILE)
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if snap.get("format") != "tdr-ctl-snapshot-v1":
+            return None
+        return snap
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        """The full arbitration state, JSON-shaped (caller holds the
+        lock). Contract: restoring this dict yields a coordinator that
+        resumes arbitration — same worlds, generations, incarnations,
+        port arena, budgets, counters — with every lease restarted."""
+        worlds = {}
+        for name, w in self._worlds.items():
+            worlds[name] = {
+                "size": w.size, "base_port": w.base_port,
+                "qp_budget": w.qp_budget, "generation": w.generation,
+                "epoch": w.epoch, "ever_ready": w.ever_ready,
+                "rebuilds": w.rebuilds,
+                "lease_expiries": w.lease_expiries, "joins": w.joins,
+                "trace_seq": w.trace_seq, "resizable": w.resizable,
+                "max_size": w.max_size, "resizes": w.resizes,
+                "weight": w.weight, "qp_share": w.qp_share,
+                "admission_rejects": w.admission_rejects,
+                "hb_throttled": w.hb_throttled,
+                "members": [{
+                    "rank": m.rank, "incarnation": m.incarnation,
+                    "host": m.host, "host_key": m.host_key,
+                    "alive": m.alive, "counters": m.counters,
+                    "hists": {h: {str(b): c for b, c in bk.items()}
+                              for h, bk in m.hists.items()},
+                    "clock_offset_ns": m.clock_offset_ns,
+                    "clock_rtt_ns": m.clock_rtt_ns,
+                    "postmortems": m.postmortems,
+                    "qp_reserved": m.qp_reserved,
+                } for m in w.members.values()],
+            }
+        return {
+            "format": "tdr-ctl-snapshot-v1",
+            "port": self.port, "lease_ms": self.lease_ms,
+            "port_base": self.port_base,
+            "port_stride": self.port_stride,
+            "qp_budget": self.qp_budget, "qp_fair": self.qp_fair,
+            "qp_floor": self.qp_floor, "next_inc": self._next_inc,
+            "failovers": self.failovers, "wall_time": time.time(),
+            "worlds": worlds,
+        }
+
+    def snapshot_now(self) -> Optional[str]:
+        """Write one snapshot atomically (tmp + rename); returns the
+        path, or None without a snapshot_dir."""
+        if not self.snapshot_dir:
+            return None
+        with self._lock:
+            snap = self._snapshot_state()
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        path = os.path.join(self.snapshot_dir, self.SNAPSHOT_FILE)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, path)
+        self._last_snapshot = time.time()
+        return path
+
+    def _snapshots(self) -> None:
+        while not self._stop.wait(self.snapshot_interval_s):
+            try:
+                self.snapshot_now()
+            except OSError:
+                pass  # a full disk must not take arbitration down
+
+    def _restore_state(self, snap: Dict[str, Any]) -> None:
+        """Resume arbitration from a snapshot: worlds come back with
+        their generations/epochs/counters intact, every member's lease
+        restarts at a full TTL (members re-attach by simply continuing
+        to heartbeat — the incarnations they hold still resolve), and
+        nobody is parked (members mid-rendezvous when the old
+        coordinator died will retry their sync/join). A restore IS a
+        failover: the counter bumps and is served on /metrics."""
+        self.lease_ms = int(snap.get("lease_ms", self.lease_ms))
+        self.port_base = int(snap.get("port_base", self.port_base))
+        self.port_stride = int(snap.get("port_stride", self.port_stride))
+        self.qp_budget = int(snap.get("qp_budget", self.qp_budget))
+        self.qp_fair = bool(snap.get("qp_fair", self.qp_fair))
+        self.qp_floor = int(snap.get("qp_floor", self.qp_floor))
+        self._next_inc = max(self._next_inc,
+                             int(snap.get("next_inc", 1)))
+        self.failovers = int(snap.get("failovers", 0)) + 1
+        now = time.monotonic()
+        lease = self.lease_ms / 1000.0
+        for name, wd in (snap.get("worlds") or {}).items():
+            w = _World(str(name), int(wd["size"]),
+                       int(wd["base_port"]), int(wd.get("qp_budget", 0)))
+            w.generation = int(wd.get("generation", 0))
+            w.epoch = int(wd.get("epoch", 0))
+            w.ever_ready = bool(wd.get("ever_ready"))
+            w.rebuilds = int(wd.get("rebuilds", 0))
+            w.lease_expiries = int(wd.get("lease_expiries", 0))
+            w.joins = int(wd.get("joins", 0))
+            w.trace_seq = int(wd.get("trace_seq", 0))
+            w.resizable = bool(wd.get("resizable"))
+            w.max_size = int(wd.get("max_size", 0))
+            w.resizes = int(wd.get("resizes", 0))
+            w.weight = float(wd.get("weight", 1.0))
+            w.qp_share = int(wd.get("qp_share", w.qp_budget))
+            w.admission_rejects = int(wd.get("admission_rejects", 0))
+            w.hb_throttled = int(wd.get("hb_throttled", 0))
+            for md in wd.get("members") or []:
+                m = _Member(int(md["rank"]), int(md["incarnation"]),
+                            str(md.get("host", "127.0.0.1")),
+                            now + lease, host_key=md.get("host_key"))
+                m.alive = bool(md.get("alive", True))
+                m.counters = {str(k): int(v) for k, v in
+                              (md.get("counters") or {}).items()}
+                m.hists = {str(h): {int(b): int(c)
+                                    for b, c in bk.items()}
+                           for h, bk in (md.get("hists") or {}).items()}
+                m.clock_offset_ns = int(md.get("clock_offset_ns", 0))
+                m.clock_rtt_ns = int(md.get("clock_rtt_ns", 0))
+                m.postmortems = int(md.get("postmortems", 0))
+                m.qp_reserved = int(md.get("qp_reserved", 0))
+                w.members[m.rank] = m
+            self._worlds[w.name] = w
+        trace.add("ctl.failover", 1)
+        trace.event("ctl.restore", worlds=len(self._worlds),
+                    failovers=self.failovers,
+                    next_inc=self._next_inc)
 
     # --------------------------------------------------------- metrics
 
@@ -644,7 +1015,17 @@ class Coordinator:
                 f"# tdr coordinator metrics v{PROTOCOL_VERSION}",
                 "# TYPE tdr_ctl_worlds gauge",
                 f"tdr_ctl_worlds {len(self._worlds)}",
+                "# TYPE tdr_ctl_failovers_total counter",
+                f"tdr_ctl_failovers_total {self.failovers}",
+                "# TYPE tdr_ctl_scrape_throttled_total counter",
+                f"tdr_ctl_scrape_throttled_total "
+                f"{self._scrape_throttled}",
             ]
+            if self.snapshot_dir:
+                age = (time.time() - self._last_snapshot
+                       if self._last_snapshot else -1.0)
+                lines.append("# TYPE tdr_ctl_snapshot_age_s gauge")
+                lines.append(f"tdr_ctl_snapshot_age_s {age:.3f}")
             lines.append("# TYPE tdr_ctl_generation gauge")
             lines.append("# TYPE tdr_ctl_members gauge")
             lines.append("# TYPE tdr_ctl_rebuilds_total counter")
@@ -662,6 +1043,17 @@ class Coordinator:
                     f"tdr_ctl_lease_expiries_total{lab} "
                     f"{w.lease_expiries}",
                     f"tdr_ctl_joins_total{lab} {w.joins}",
+                    f"tdr_ctl_resizes_total{lab} {w.resizes}",
+                    f"tdr_ctl_resizable{lab} {int(w.resizable)}",
+                    # Fair-share gauges: this world's computed slice
+                    # of the engine QP pool vs the appetite its live
+                    # members actually reserved at bring-up.
+                    f"tdr_ctl_qp_share{lab} {w.qp_share}",
+                    f"tdr_ctl_qp_reserved{lab} "
+                    f"{sum(m.qp_reserved for m in w.alive_members())}",
+                    f"tdr_ctl_admission_rejects_total{lab} "
+                    f"{w.admission_rejects}",
+                    f"tdr_ctl_hb_throttled_total{lab} {w.hb_throttled}",
                     # Black-box postmortems written across the world's
                     # slots (heartbeat-pushed; slots keep serving their
                     # current occupant's tally like every other series).
@@ -725,3 +1117,90 @@ class Coordinator:
                     lines.append(
                         f"tdr_{safe}_count{lab} {sum(hists[hname])}")
             return "\n".join(lines) + "\n"
+
+
+class Standby:
+    """Warm standby for the coordinator: tails the snapshot directory,
+    probes the active coordinator's ``/healthz``, and after
+    ``fail_threshold`` consecutive probe failures promotes itself —
+    restoring the latest snapshot and binding the SAME port the fleet
+    already dials (the dead coordinator's socket is gone, so the bind
+    succeeds exactly when takeover is legitimate). Members notice
+    nothing but a missed heartbeat or two: their incarnations still
+    resolve against the restored state.
+
+    ``promoted`` is set once takeover completed; ``coordinator`` then
+    holds the live replacement (the caller owns stopping it)."""
+
+    def __init__(self, snapshot_dir: str, address: Optional[str] = None,
+                 host: str = "127.0.0.1", probe_interval_s: float = 0.5,
+                 fail_threshold: int = 3):
+        self.snapshot_dir = snapshot_dir
+        self.address = address  # None: probe the snapshot's port
+        self.host = host
+        self.probe_interval_s = max(0.05, float(probe_interval_s))
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.coordinator: Optional[Coordinator] = None
+        self.promoted = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _probe_target(self) -> Optional[tuple]:
+        if self.address:
+            host, _, port = self.address.rpartition(":")
+            return (host, int(port))
+        snap = Coordinator._load_snapshot(self.snapshot_dir)
+        if snap is None:
+            return None
+        return (self.host, int(snap.get("port", 0)))
+
+    def _healthy(self, target: tuple) -> bool:
+        try:
+            with socket.create_connection(target, timeout=2.0) as s:
+                s.sendall(b"GET /healthz HTTP/1.0\r\n\r\n")
+                return b"200" in s.recv(256)
+        except OSError:
+            return False
+
+    def _watch(self) -> None:
+        failures = 0
+        while not self._stop.wait(self.probe_interval_s):
+            target = self._probe_target()
+            if target is None or not target[1]:
+                continue  # no snapshot yet: nothing to guard
+            if self._healthy(target):
+                failures = 0
+                continue
+            failures += 1
+            if failures < self.fail_threshold:
+                continue
+            try:
+                self.coordinator = Coordinator(
+                    host=self.host, port=0, restore=True,
+                    snapshot_dir=self.snapshot_dir).start()
+            except OSError:
+                # Port still held (the old coordinator is wedged, not
+                # dead, or another standby won the race): keep
+                # probing — takeover is only legitimate once the bind
+                # succeeds.
+                failures = 0
+                continue
+            trace.add("ctl.failover", 1)
+            trace.event("ctl.standby_takeover",
+                        address=self.coordinator.address,
+                        failovers=self.coordinator.failovers)
+            self.promoted.set()
+            return
+
+    def start(self) -> "Standby":
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="tdr-ctl-standby")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.coordinator is not None:
+            self.coordinator.stop()

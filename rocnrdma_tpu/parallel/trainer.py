@@ -354,7 +354,23 @@ class Trainer:
                     attempt=attempt, error=str(exc)[:160])
         world = getattr(self.cross_slice_sync, "world", None)
         if world is not None:
-            world.rebuild(**self.elastic.rebuild)
+            old_size = getattr(world, "world", None)
+            kw = dict(self.elastic.rebuild)
+            kw.setdefault("reason", str(exc)[:400])
+            world.rebuild(**kw)
+            new_size = getattr(world, "world", None)
+            if old_size is not None and new_size != old_size:
+                # A world RESIZE rode the rebuild: the coordinator cut
+                # a view at a different size (shrink-to-survivors or
+                # grow-on-join). The data-parallel batch shard
+                # rebalances by construction — every sync scales by
+                # the CURRENT world size — but the change is a
+                # training-semantics event (global batch moved), so it
+                # is counted and stamped for the postmortem timeline.
+                trace.add("trainer.resize", 1)
+                trace.event("trainer.resize",
+                            step=self.global_step + 1,
+                            old_size=old_size, new_size=new_size)
         reset = getattr(self.cross_slice_sync, "reset_transport_cache", None)
         if reset is not None:
             reset()

@@ -544,3 +544,282 @@ def test_healthz_and_unknown_path():
             assert s.recv(4096).startswith(b"HTTP/1.0 404")
     finally:
         coord.stop()
+
+
+# ------------------------------------------ elastic fleet (PR 16)
+
+
+def test_snapshot_restore_roundtrip_equality(tmp_path):
+    """Snapshot -> restore is state-equal: same port, worlds,
+    generations, incarnations, resize/rebuild counters; the only
+    deltas are the failover count (+1 — a restore IS a failover) and
+    the leases (restarted at a full TTL). Old incarnations re-attach
+    by simply continuing to heartbeat."""
+    import json
+    import os
+
+    snapdir = str(tmp_path)
+    c1 = Coordinator(port=0, lease_ms=1500, port_base=_free_port(),
+                     snapshot_dir=snapdir).start()
+    client = ControlClient(c1.address)
+    views = _join_all(client, "w", 2, resizable=True)
+    client.report("w", 0, views[0]["incarnation"],
+                  views[0]["generation"], "boom")
+    c1.stop()  # writes the final snapshot
+    with open(os.path.join(snapdir, Coordinator.SNAPSHOT_FILE)) as f:
+        snap = json.load(f)
+    assert snap["format"] == "tdr-ctl-snapshot-v1"
+
+    c2 = Coordinator(port=0, restore=True, snapshot_dir=snapdir).start()
+    try:
+        # port=0 + restore adopts the snapshot's port: the fleet keeps
+        # dialing the address it already knows.
+        assert c2.address == c1.address
+        path = c2.snapshot_now()
+        with open(path) as f:
+            snap2 = json.load(f)
+        assert snap2["failovers"] == snap["failovers"] + 1
+        assert snap2["next_inc"] >= snap["next_inc"]
+        volatile = ("wall_time", "failovers")
+        a = {k: v for k, v in snap.items() if k not in volatile}
+        b = {k: v for k, v in snap2.items() if k not in volatile}
+        assert a == b  # worlds, members, counters: bit-identical
+        # Members never re-rendezvous: the incarnation each holds
+        # still resolves, so a plain heartbeat renews the lease.
+        c2client = ControlClient(c2.address)
+        for v in views:
+            hb = c2client.heartbeat("w", v["rank"], v["incarnation"],
+                                    v["generation"])
+            assert hb["ok"]
+    finally:
+        c2.stop()
+
+
+def test_shrink_then_grow_generation_monotone():
+    """World-3 shrinks to 2 (leave), then grows back to 3 (join on the
+    full world): each RESIZE repacks ranks contiguously, bumps the
+    resize count, and moves generation/epoch strictly forward — the
+    digest inputs never run backwards."""
+    c = Coordinator(port=0, lease_ms=1500, port_base=_free_port()).start()
+    try:
+        client = ControlClient(c.address)
+        views = _join_all(client, "w", 3, resizable=True)
+        gen0 = views[0]["generation"]
+
+        # Rank 2 leaves; the survivors park -> world_size-1 view.
+        client.leave("w", 2, views[2]["incarnation"])
+        out = [None, None]
+
+        def s(r, inc):
+            out[r] = client.sync("w", r, inc, timeout_s=10)
+
+        ts = [threading.Thread(target=s, args=(r, views[r]["incarnation"]))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(v["ok"] for v in out)
+        assert out[0]["world_size"] == 2
+        assert out[0]["resizes"] == 1
+        assert sorted(v["rank"] for v in out) == [0, 1]
+        gen1 = out[0]["generation"]
+        assert gen1 > gen0
+
+        # A joiner on the now-FULL world parks; the incumbents park at
+        # their next boundary -> world_size+1 view.
+        jr = [None]
+
+        def j():
+            jr[0] = client.join("w", 2, rank=-1, resizable=True,
+                                timeout_s=15)
+
+        jt = threading.Thread(target=j)
+        jt.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with c._cv:
+                if len(c._worlds["w"].members) == 3:
+                    break
+            time.sleep(0.02)
+        ts = [threading.Thread(target=s, args=(r, out[r]["incarnation"]))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        jt.join()
+        assert jr[0]["ok"] and jr[0]["rank"] == 2
+        assert jr[0]["world_size"] == 3
+        assert all(v["ok"] and v["world_size"] == 3 for v in out)
+        assert out[0]["resizes"] == 2
+        assert out[0]["generation"] > gen1
+        assert out[0]["epoch"] > views[0]["epoch"]
+    finally:
+        c.stop()
+
+
+def test_admission_backpressure_retryable_with_retry_after():
+    """A join the fleet cannot admit is RETRYABLE backpressure with a
+    deterministic retry-after, not a hard failure: both the full
+    non-resizable world and the --max-worlds quota say so."""
+    c = Coordinator(port=0, lease_ms=1500, port_base=_free_port(),
+                    max_worlds=1).start()
+    try:
+        client = ControlClient(c.address)
+        _join_all(client, "w", 2)
+        r = client.join("w", 2, rank=-1, timeout_s=5)
+        assert not r["ok"] and r["retryable"]
+        assert r["error"] == "fleet full"
+        assert r["retry_after_s"] == pytest.approx(1.5)
+        # Deterministic spread: the second reject backs off longer.
+        r2 = client.join("w", 2, rank=-1, timeout_s=5)
+        assert r2["retry_after_s"] == pytest.approx(3.0)
+        # World quota: same verdict shape for a brand-new world.
+        q = client.join("other", 2, rank=0, timeout_s=5)
+        assert not q["ok"] and q["retryable"]
+        assert "quota" in q["error"]
+        body = client.metrics()
+        assert _metric_value(
+            body, 'tdr_ctl_admission_rejects_total{world="w"}') == 2.0
+    finally:
+        c.stop()
+
+
+def test_fair_share_division_with_floor():
+    """--qp-fair divides the engine QP pool across worlds by join-time
+    weight with a per-world floor; the share rides the view's
+    qp_budget so members adopt it at the next rendezvous."""
+    c = Coordinator(port=0, lease_ms=1500, port_base=_free_port(),
+                    qp_budget=90, qp_fair=True, qp_floor=5).start()
+    try:
+        client = ControlClient(c.address)
+        va = _join_all(client, "a", 2, resizable=True)
+        assert va[0]["qp_budget"] == 90  # alone: the whole pool
+        vb = _join_all(client, "b", 2, resizable=True, weight=2.0)
+        assert vb[0]["qp_budget"] == 60  # 90 * 2/(1+2)
+        body = client.metrics()
+        assert _metric_value(body, 'tdr_ctl_qp_share{world="a"}') == 30.0
+        assert _metric_value(body, 'tdr_ctl_qp_share{world="b"}') == 60.0
+        # The floor beats the proportional share for a featherweight.
+        _join_all(client, "tiny", 2, resizable=True, weight=0.01)
+        body = client.metrics()
+        assert _metric_value(
+            body, 'tdr_ctl_qp_share{world="tiny"}') == 5.0
+    finally:
+        c.stop()
+
+
+def test_heartbeat_after_leave_stops_and_is_rejected(coord):
+    """The heartbeat-after-leave fix, both sides: the coordinator
+    refuses (never re-adopts) a push under a superseded identity, and
+    the member-side Heartbeat stops sending under that identity until
+    state_fn reports a different (incarnation, rank)."""
+    client = ControlClient(coord.address)
+    views = _join_all(client, "w", 2)
+    inc1 = views[1]["incarnation"]
+    client.leave("w", 1, inc1)
+    # Coordinator side: the old identity is dead, not re-adoptable.
+    r = client.heartbeat("w", 1, inc1, views[1]["generation"],
+                         counters={"integrity.sealed": 7})
+    assert not r["ok"] and r["error"] == "superseded"
+    body = client.metrics()
+    assert 'tdr_integrity_sealed_total{world="w",rank="1"}' not in body
+
+    # Member side: after one refusal the thread goes quiet under the
+    # dead identity...
+    state = {"v": (inc1, views[1]["generation"], 1)}
+    sent = []
+    real_hb = client.heartbeat
+    client.heartbeat = lambda *a, **kw: sent.append(a) or real_hb(*a, **kw)
+    hb = client.start_heartbeat("w", 1, lambda: state["v"],
+                                interval_s=3600)
+    try:
+        assert hb.beat() and hb._dead_key == (inc1, 1)
+        n = len(sent)
+        assert hb.beat() and len(sent) == n  # no wire push: superseded
+        # ...and resumes the moment the identity changes (a RESIZE
+        # moves the rank under the same incarnation).
+        state["v"] = (inc1, views[1]["generation"], 0)
+        hb.beat()
+        assert len(sent) == n + 1
+    finally:
+        client.heartbeat = real_hb
+        hb.stop()
+
+
+def test_metrics_scrape_rate_limit_429():
+    """A hot scraper gets 429 backpressure with a deterministic
+    retry-after, not the render cost: the first scrape in the window
+    is served, the second refused and counted."""
+    from rocnrdma_tpu.control.client import ControlError
+
+    c = Coordinator(port=0, port_base=_free_port(),
+                    scrape_min_interval_ms=30000).start()
+    try:
+        client = ControlClient(c.address)
+        body = client.metrics()  # first scrape in the window is served
+        assert "tdr_ctl_scrape_throttled_total 0" in body
+        with pytest.raises(ControlError, match="429"):
+            client.metrics()
+        # The refusal is counted; the next successful scrape serves it.
+        assert c._scrape_throttled == 1
+    finally:
+        c.stop()
+
+
+def test_heartbeat_rate_limit_sheds_payload_keeps_lease():
+    """Per-world heartbeat rate limit: an over-eager beater still
+    renews its lease (liveness is cheap) but the telemetry payload is
+    shed and the shed counted — sealed stays at the first push."""
+    c = Coordinator(port=0, lease_ms=1500, port_base=_free_port(),
+                    hb_min_interval_ms=60000).start()
+    try:
+        client = ControlClient(c.address)
+        views = _join_all(client, "w", 2)
+        inc = views[0]["incarnation"]
+        gen = views[0]["generation"]
+        r1 = client.heartbeat("w", 0, inc, gen,
+                              counters={"integrity.sealed": 1})
+        assert r1["ok"] and not r1.get("throttled")
+        r2 = client.heartbeat("w", 0, inc, gen,
+                              counters={"integrity.sealed": 2})
+        assert r2["ok"] and r2["throttled"]  # lease renewed, payload shed
+        body = client.metrics()
+        assert _metric_value(
+            body, 'tdr_ctl_hb_throttled_total{world="w"}') == 1.0
+        assert _metric_value(
+            body, 'tdr_integrity_sealed_total{world="w"}') == 1.0
+    finally:
+        c.stop()
+
+
+def test_standby_promotes_on_primary_death(tmp_path):
+    """Warm standby: tails snapshots, probes the primary's /healthz,
+    and after the primary dies promotes itself on the SAME port with
+    the restored state (failovers bumped)."""
+    from rocnrdma_tpu.control.coordinator import Standby
+
+    snapdir = str(tmp_path)
+    c1 = Coordinator(port=0, lease_ms=1500, port_base=_free_port(),
+                     snapshot_dir=snapdir,
+                     snapshot_interval_s=0.1).start()
+    client = ControlClient(c1.address)
+    views = _join_all(client, "w", 2, resizable=True)
+    c1.snapshot_now()
+    sb = Standby(snapdir, address=c1.address, probe_interval_s=0.1,
+                 fail_threshold=2).start()
+    try:
+        time.sleep(0.5)
+        assert not sb.promoted.is_set()  # healthy primary: no takeover
+        c1.stop()
+        assert sb.promoted.wait(10)
+        assert sb.coordinator is not None
+        assert sb.coordinator.address == c1.address
+        hb = client.heartbeat("w", 0, views[0]["incarnation"],
+                              views[0]["generation"])
+        assert hb["ok"]
+        body = client.metrics()
+        assert _metric_value(body, "tdr_ctl_failovers_total") >= 1.0
+    finally:
+        sb.stop()

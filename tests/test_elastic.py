@@ -255,3 +255,123 @@ def test_world8_two_simultaneous_kills_rejoin_bitwise(tmp_path):
         for key in faulty:
             assert faulty[key].tobytes() == other[key].tobytes(), \
                 (r, key)
+
+
+def test_world4_shrink_to_3_resize_bitwise_parity(tmp_path):
+    """World RESIZE, the shrink side: a 4-rank resizable world loses
+    rank 3 to a clean leave; the survivors' next collective fails
+    retryable, ``rebuild()`` re-arbitrates the SAME incarnations as a
+    contiguous world-3 (no process restart, no checkpoint), and the
+    post-shrink allreduce is bitwise-exact at the new size. The resize
+    count lands in the schedule digest, so a membership-view split can
+    never silently agree."""
+    import threading
+
+    from rocnrdma_tpu.collectives.world import RingWorld
+    from rocnrdma_tpu.control.coordinator import Coordinator
+    from rocnrdma_tpu.transport.engine import Engine, TransportError
+
+    coord = Coordinator(port=0, lease_ms=2000,
+                        port_base=_free_base()).start()
+    engines = [Engine("emu") for _ in range(4)]
+    worlds = [None] * 4
+    try:
+        errs = [None] * 4
+
+        def boot(r):
+            try:
+                worlds[r] = RingWorld(engines[r], r, 4, None,
+                                      controller=coord.address,
+                                      world_name="shrink",
+                                      timeout_ms=20000, resizable=True)
+            except Exception as e:
+                errs[r] = e
+
+        ts = [threading.Thread(target=boot, args=(r,)) for r in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(e is None for e in errs), errs
+
+        # Round 1 at world 4: payload rank+1, bitwise-checked.
+        r1 = [None] * 4
+
+        def ar4(r):
+            buf = np.full(512, 3 * (r + 1), np.int32)
+            worlds[r].allreduce(buf)
+            r1[r] = buf
+
+        ts = [threading.Thread(target=ar4, args=(r,)) for r in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        want4 = np.full(512, 3 * 10, np.int32)  # 3 * (1+2+3+4)
+        for r in range(4):
+            assert r1[r].tobytes() == want4.tobytes(), r
+
+        # Rank 3 leaves cleanly (autoscaler scale-down).
+        worlds[3].close()
+        worlds[3] = None
+
+        # The next heartbeat response carries the resize hint to EVERY
+        # survivor — including rank 1, which is not ring-adjacent to
+        # the departed rank and would otherwise stall a full ring
+        # timeout before noticing. With the hint set, the first
+        # collective attempt fails fast at entry instead.
+        import time
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(worlds[r]._resize_pending for r in range(3)):
+                break
+            time.sleep(0.05)
+        assert all(worlds[r]._resize_pending for r in range(3))
+
+        # Survivors: the next collective fails retryable; rebuild()
+        # re-arbitrates and the coordinator answers with the SHRUNK
+        # shape. Payload is recomputed from the post-rebuild rank.
+        r2 = [None] * 3
+        fails = [None] * 3
+
+        def recover(r):
+            w = worlds[r]
+            try:
+                for attempt in range(8):
+                    buf = np.full(512, 7 * (w.rank + 1), np.int32)
+                    try:
+                        w.allreduce(buf)
+                        r2[r] = buf
+                        return
+                    except TransportError as e:
+                        if not getattr(e, "retryable", False):
+                            raise
+                        w.rebuild(max_attempts=10, backoff_s=0.2,
+                                  timeout_ms=10000,
+                                  reason="rank 3 left (shrink)")
+                raise AssertionError("no successful post-shrink round")
+            except BaseException as e:
+                fails[r] = e
+
+        ts = [threading.Thread(target=recover, args=(r,))
+              for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(f is None for f in fails), fails
+
+        want3 = np.full(512, 7 * 6, np.int32)  # 7 * (1+2+3)
+        for r in range(3):
+            w = worlds[r]
+            assert w.world == 3 and w.rank == r, (r, w.world, w.rank)
+            assert w._ctl_resizes == 1
+            assert ":r1" in w.control_stamp, w.control_stamp
+            assert r2[r].tobytes() == want3.tobytes(), r
+    finally:
+        for w in worlds:
+            if w is not None:
+                w.close()
+        coord.stop()
+        for eng in engines:
+            eng.close()

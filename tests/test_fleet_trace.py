@@ -484,10 +484,21 @@ def test_tdr_top_fleet_view_renders_metrics():
         'tdr_clock_rtt_us{world="train",rank="0"} 300',
         'tdr_clock_rtt_us{world="train",rank="1"} 500',
         'tdr_telemetry_dropped_total{world="train",rank="1"} 9',
+        'tdr_ctl_worlds 1',
+        'tdr_ctl_failovers_total 2',
+        'tdr_ctl_snapshot_age_s 0.75',
+        'tdr_ctl_resizes_total{world="train"} 6',
+        'tdr_ctl_qp_share{world="train"} 18',
+        'tdr_ctl_qp_reserved{world="train"} 12',
+        'tdr_ctl_admission_rejects_total{world="train"} 3',
+        'tdr_ctl_hb_throttled_total{world="train"} 7',
     ])
     frame = tdr_top.render_fleet(text)
+    assert "fleet: worlds=1 failovers=2 snapshot_age=0.8s" in frame
     assert "world train: gen=3 epoch=5 members=2/2" in frame
-    assert "rebuilds=1 postmortems=4" in frame
+    assert "rebuilds=1 resizes=6 postmortems=4" in frame
+    assert ("qp_share=18 qp_reserved=12 admission_rejects=3 "
+            "hb_throttled=7") in frame
     assert "retransmit_rate=0.0125" in frame and "chunk_p99_us=1234" in frame
     assert "rank 0: clock_offset=-12.5us (rtt 300.0us) dropped=0" in frame
     assert "rank 1: clock_offset=+40.0us" in frame

@@ -504,3 +504,49 @@ def test_coordinator_view_carries_host_keys():
         coord.stop()
         if prev is not None:
             os.environ["TDR_TOPOLOGY"] = prev
+
+
+def test_fallback_reason_unit_shapes():
+    """The deterministic fallback note, shape by shape: nothing to
+    fall back from (no topology), a carryable topology, the remainder
+    case, and all-singleton groups."""
+    from rocnrdma_tpu.collectives.topology import fallback_reason
+
+    assert fallback_reason(None) == ""
+    assert fallback_reason(TopologyMap(["a", "a", "b", "b"], 0)) == ""
+    assert fallback_reason(
+        TopologyMap(["a", "a", "b"], 0)) == "nonuniform:h2:2x1"
+    assert fallback_reason(TopologyMap(["a", "b"], 0)) == "singleton:h2"
+
+
+def test_nonuniform_fallback_warn_once_and_digest_note():
+    """The remainder case end to end: a RESOLVED 2-host topology with
+    uneven groups (the post-uneven-shrink shape) cannot carry hier.
+    Bring-up warns once per world object (``algo.fallback``), the
+    schedule digest carries the deterministic fallback note — two
+    ranks disagreeing on WHY they fell back must not agree — and
+    collectives run flat and bitwise-correct."""
+    from rocnrdma_tpu.utils.trace import trace
+
+    before = trace.counter("algo.fallback")
+    worlds = hier_worlds(3, ["a", "a", "b"])
+    try:
+        # Every brought-up world object warned exactly once (bring-up
+        # retries construct fresh objects, so >= not ==).
+        after_boot = trace.counter("algo.fallback")
+        assert after_boot >= before + 3
+        assert all(w._fallback_warned for w in worlds)
+        for w in worlds:
+            assert w.topology_stamp == "topo=fallback:nonuniform:h2:2x1"
+            assert not w.topology.hierarchical
+        bufs = [np.full(64, r + 1, np.float32) for r in range(3)]
+        run_all(worlds, lambda r: worlds[r].allreduce(bufs[r]))
+        want = np.full(64, 6.0, np.float32)
+        for b in bufs:
+            assert b.tobytes() == want.tobytes()
+        # Warn-ONCE: further collectives never re-count the fallback.
+        run_all(worlds, lambda r: worlds[r].allreduce(bufs[r]))
+        assert trace.counter("algo.fallback") == after_boot
+    finally:
+        for w in worlds:
+            w.close()

@@ -53,6 +53,16 @@ def free_port() -> int:
     return port
 
 
+def hb_thread_census() -> int:
+    """Count live coordinator heartbeat threads (tdr-ctl-hb-*). The
+    leak gate for the elastic soaks: every closed, departed, or
+    resized-out world must have stopped renewing its lease — a thread
+    still beating under a superseded identity is the
+    heartbeat-after-leave bug."""
+    return sum(1 for t in threading.enumerate()
+               if t.name.startswith("tdr-ctl-hb-") and t.is_alive())
+
+
 def make_fault_plan(seed: int, steps: int, world: int = 2) -> str:
     """A seeded-random transient collective fault somewhere in the run,
     plus a seeded payload corruption on the sealed zero-copy path.

@@ -192,11 +192,19 @@ def render_fleet(metrics_text: str) -> str:
     """The --connect frame: one block per named world."""
     m = parse_metrics(metrics_text)
     lines = ["tdr_top — fleet view (coordinator /metrics)", ""]
+    failovers = _metric_global(m, "tdr_ctl_failovers_total")
+    snap_age = _metric_global(m, "tdr_ctl_snapshot_age_s", default=None)
+    fleet_bits = [f"worlds={int(_metric_global(m, 'tdr_ctl_worlds'))}",
+                  f"failovers={int(failovers)}"]
+    if snap_age is not None:
+        fleet_bits.append("snapshot_age=never" if snap_age < 0
+                          else f"snapshot_age={snap_age:.1f}s")
+    lines.insert(1, "fleet: " + " ".join(fleet_bits))
     worlds = sorted({labels.get("world")
                      for labels, _ in m.get("tdr_ctl_generation", ())
                      if labels.get("world")})
     if not worlds:
-        return lines[0] + "\n\n(no worlds registered)"
+        return "\n".join(lines[:2]) + "\n\n(no worlds registered)"
     for w in worlds:
         size = int(_metric(m, "tdr_ctl_size", w))
         lines.append(
@@ -204,7 +212,15 @@ def render_fleet(metrics_text: str) -> str:
             f"epoch={int(_metric(m, 'tdr_ctl_epoch', w))} "
             f"members={int(_metric(m, 'tdr_ctl_members', w))}/{size} "
             f"rebuilds={int(_metric(m, 'tdr_ctl_rebuilds_total', w))} "
+            f"resizes={int(_metric(m, 'tdr_ctl_resizes_total', w))} "
             f"postmortems={int(_metric(m, 'tdr_postmortems_total', w))}")
+        lines.append(
+            f"  qp_share={int(_metric(m, 'tdr_ctl_qp_share', w))}"
+            f" qp_reserved={int(_metric(m, 'tdr_ctl_qp_reserved', w))}"
+            f" admission_rejects="
+            f"{int(_metric(m, 'tdr_ctl_admission_rejects_total', w))}"
+            f" hb_throttled="
+            f"{int(_metric(m, 'tdr_ctl_hb_throttled_total', w))}")
         lines.append(
             f"  retransmit_rate={_metric(m, 'tdr_retransmit_rate', w):.4g}"
             f"  chunk_p99_us="
@@ -231,6 +247,14 @@ def _rank_key(r):
         return (0, int(r))
     except (TypeError, ValueError):
         return (1, str(r))
+
+
+def _metric_global(m: dict, name: str, default: float = 0.0):
+    """First sample of a label-less fleet metric (or `default`)."""
+    for labels, val in m.get(name, ()):
+        if not labels:
+            return val
+    return default
 
 
 def _metric_q(m: dict, name: str, world: str, q: str) -> float:
