@@ -423,6 +423,27 @@ class CrossSliceAllReduce:
         topo_stamp = getattr(self.world, "topology_stamp", "")
         if topo_stamp:
             sched.append(topo_stamp)
+        # Degradation-ladder rungs (hier→flat fallback, bf16 wire
+        # downgrade on the sick delegate link) are schedule- and
+        # precision-changing: ranks whose health scores crossed a rung
+        # at different times must fail the digest retryably, never
+        # silently sum at mixed precision. Healthy worlds contribute
+        # NOTHING (legacy digests byte-identical).
+        health_stamp = getattr(self.world, "health_stamp", "")
+        if health_stamp:
+            sched.append(health_stamp)
+        # The per-collective hard deadline changes when ranks give up
+        # and rebuild; a rank running with a deadline against ranks
+        # without one would escalate alone. Unset (0 = off, the
+        # default) contributes nothing.
+        dl_ms = os.environ.get("TDR_COLL_DEADLINE_MS", "")
+        if dl_ms:
+            try:
+                dl = int(dl_ms)
+            except ValueError:
+                dl = 0
+            if dl > 0:
+                sched.append(f"dl={dl}")
         # Recv-reduce gating is schedule-selecting too (fused
         # reduce-on-receive vs the windowed-scratch schedule), and it
         # is a PER-PROCESS env knob (TDR_NO_RECV_REDUCE), never

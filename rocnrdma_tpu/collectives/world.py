@@ -68,6 +68,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from rocnrdma_tpu.collectives import health as _health
 from rocnrdma_tpu.collectives.topology import (TopologyMap, algo_stamp,
                                                choose_algo,
                                                fallback_reason,
@@ -918,9 +919,21 @@ class RingWorld:
         def _extras():
             # Bring-up QP reservation, pushed so the coordinator can
             # serve tdr_ctl_qp_reserved{world=} (reserved appetite vs
-            # the fair share it granted).
+            # the fair share it granted) — plus the link-health
+            # snapshot and degradation tally, served as
+            # tdr_link_health{world=,rank=,peer=} and
+            # tdr_degraded_total{world=} (slow-rank quarantine: the
+            # coordinator names WHICH link the ladder degraded).
             w = wself()
-            return {} if w is None else {"qp_reserved": w._qp_reserved}
+            if w is None:
+                return {}
+            ex = {"qp_reserved": w._qp_reserved}
+            hs = _health.snapshot(w.world_name)
+            if hs:
+                ex["link_health"] = hs
+                ex["degraded_total"] = _health.degraded_total(
+                    w.world_name)
+            return ex
 
         self._hb = self.controller.start_heartbeat(
             self.world_name, self.rank, state_fn=_state,
@@ -1078,28 +1091,52 @@ class RingWorld:
         self._live_ring()  # torn down -> retryable, before bring-up
         world, hosts = self.world, topo.n_hosts
         nchan = self._tier_channels()
+        # QP budget honesty: each tier ring carries its own slice of
+        # this world's reservation (2 QPs per channel, already counted
+        # in _qp_reserved at bootstrap) so the bookkeeping the
+        # coordinator granted holds all the way down the hierarchy —
+        # a tier can never quietly out-grow what the parent reserved.
+        tier_budget = None if self.qp_budget is None else 2 * nchan
         intra_base = self.base_port + world * (1 + topo.host_index)
-        intra = RingWorld(
-            self.engine, topo.local_rank, topo.local_size, intra_base,
-            peers=[self.peers[g] for g in topo.group],
-            bind_host=self.bind_host, timeout_ms=self.timeout_ms,
-            generation=self.generation, channels=nchan,
-            topology="flat", world_name=self.world_name + ".intra")
         try:
-            inter_base = (self.base_port + world * (1 + hosts)
-                          + topo.local_rank * hosts)
-            inter = RingWorld(
-                self.engine, topo.host_index, hosts, inter_base,
-                peers=[self.peers[g] for g in topo.delegate_ring()],
+            intra = RingWorld(
+                self.engine, topo.local_rank, topo.local_size,
+                intra_base,
+                peers=[self.peers[g] for g in topo.group],
                 bind_host=self.bind_host, timeout_ms=self.timeout_ms,
                 generation=self.generation, channels=nchan,
-                topology="flat", tier="stream",
-                world_name=self.world_name + f".x{topo.local_rank}")
-        except BaseException:
+                topology="flat", qp_budget=tier_budget,
+                world_name=self.world_name + ".intra")
             try:
-                intra.close()
-            except Exception:
-                pass
+                inter_base = (self.base_port + world * (1 + hosts)
+                              + topo.local_rank * hosts)
+                inter = RingWorld(
+                    self.engine, topo.host_index, hosts, inter_base,
+                    peers=[self.peers[g] for g in topo.delegate_ring()],
+                    bind_host=self.bind_host,
+                    timeout_ms=self.timeout_ms,
+                    generation=self.generation, channels=nchan,
+                    topology="flat", tier="stream",
+                    qp_budget=tier_budget,
+                    world_name=self.world_name + f".x{topo.local_rank}")
+            except BaseException:
+                try:
+                    intra.close()
+                except Exception:
+                    pass
+                raise
+        except TransportError as e:
+            if "qp budget exhausted" in str(e) and not e.retryable:
+                # The NATIVE engine pool rejected a tier QP: at the
+                # engine layer that is deliberately non-retryable (a
+                # mis-sized single world must fail loudly, test-pinned)
+                # — but DURING tier bring-up it usually means transient
+                # co-tenant pressure on a shared engine, and the
+                # rebuild ladder is exactly the fail-fast retry that
+                # resolves it once the co-tenant releases QPs.
+                raise TransportError(
+                    f"tier bring-up on rank {self.rank}: {e}",
+                    retryable=True) from e
             raise
         self._tier_intra, self._tier_inter = intra, inter
         self._tier_gen = self.generation
@@ -1142,6 +1179,23 @@ class RingWorld:
             return f"topo=fallback:{fb}" if fb else ""
         return f"{topo.stamp()} {algo_stamp(topo)}"
 
+    @property
+    def health_stamp(self) -> str:
+        """Schedule-digest term for the degradation ladder's engaged
+        rungs: hier→flat fallback and/or the bf16 wire downgrade are
+        schedule/precision-changing, so ranks must agree on them the
+        way they agree on topology. A healthy world contributes
+        NOTHING — legacy digests stay byte-identical. Divergence
+        (multi-process ranks whose scores crossed a rung at different
+        times) fails the next digest exchange retryably; the scores
+        converge and the following collective re-agrees."""
+        terms = []
+        if _health.fallback_active(self.world_name):
+            terms.append("health=flat")
+        if _health.wire_downgrade(self.world_name):
+            terms.append("hwire=bf16")
+        return " ".join(terms)
+
     def _algo_for(self, nbytes: int, algo: Optional[str]) -> str:
         """Resolve the per-call algorithm (explicit override or the
         size/topology selector), degrading hier to flat when the
@@ -1161,6 +1215,26 @@ class RingWorld:
             if int(nbytes) == 0 or \
                     int(nbytes) < self.world * 8:  # conservative floor
                 return "flat"
+            # Degradation-ladder rung 2: a sick delegate link (EWMA
+            # goodput collapsed vs its own history, or hard fault
+            # evidence) falls the schedule back to the flat ring —
+            # slower, but it stops riding the link that would
+            # otherwise stall into the deadline/rebuild escalation.
+            # TDR_NO_DEGRADE=1 disables the rung (health.py).
+            # The verdict is frozen per collective, keyed on the NEXT
+            # collective's sequence number (_next_coll has not run
+            # yet): the rung state can flip mid-window under another
+            # rank's observe/fault, and ranks reading it live would
+            # split across hier/flat and deadlock. 'canary': an
+            # every-Nth probe collective that rides the sick link so
+            # the score can heal (health.schedule_verdict).
+            v = _health.schedule_verdict(self.world_name,
+                                         self._coll_seq + 1)
+            if v == "flat":
+                trace.add("algo.degraded", 1)
+                return "flat"
+            if v == "canary":
+                trace.add("health.probation", 1)
         return algo
 
     def allreduce(self, array, op: int = RED_SUM,
@@ -1205,6 +1279,11 @@ class RingWorld:
         intra, inter = self._ensure_tiers()
         topo = self.topology
         coll = self._next_coll()
+        # Health attribution: the delegate link's peer is the NEXT
+        # delegate on the inter ring (global rank) — the label
+        # quarantine reporting and tdr_explain name stragglers by.
+        ring_order = topo.delegate_ring()
+        inter_peer = ring_order[(topo.host_index + 1) % topo.n_hosts]
         with trace.span("world.hier_allreduce", rank=self.rank,
                         bytes=int(array.nbytes), hosts=topo.n_hosts,
                         local=topo.local_size, coll=coll):
@@ -1214,10 +1293,41 @@ class RingWorld:
             # ring's events vs the delegate ring's) by the tier-world
             # lanes they ride on.
             intra._seed_coll(coll)
+            t0 = time.monotonic()
             own = intra.reduce_scatter(array, op)
+            _health.observe(self.world_name, f"intra:r{self.rank}", -1,
+                            int(array.nbytes), time.monotonic() - t0)
             shard = array.reshape(-1)[own]
+            # Degradation-ladder rung 1: quantize the inter-host
+            # payload to bf16 PRECISION (mantissa truncation, in
+            # place — ``shard`` is a view) when the delegate link is
+            # degraded but not yet fallback-sick. Exactly-representable
+            # values (the bitwise-parity test regime) survive the
+            # truncation losslessly; the precision change is
+            # digest-stamped (health_stamp) so ranks that disagree
+            # fail the next schedule exchange retryably instead of
+            # folding mixed precision.
+            if shard.dtype == np.float32 and \
+                    _health.wire_downgrade(self.world_name):
+                trace.add("health.wire_bf16", 1)
+                shard.view(np.uint32)[...] &= np.uint32(0xFFFF0000)
             inter._seed_coll(coll)
-            inter.allreduce(shard, op, algo="flat")
+            t0 = time.monotonic()
+            try:
+                inter.allreduce(shard, op, algo="flat")
+            except TransportError as e:
+                # Hard evidence beats EWMA drift: stall/deadline/hung
+                # verdicts on the delegate link halve its score NOW,
+                # so the post-rebuild world comes back degraded
+                # instead of re-riding the sick link at full speed.
+                if e.retryable:
+                    _health.fault(self.world_name,
+                                  f"inter:r{self.rank}", inter_peer,
+                                  kind=e.kind)
+                raise
+            _health.observe(self.world_name, f"inter:r{self.rank}",
+                            inter_peer, int(shard.nbytes),
+                            time.monotonic() - t0)
             intra._seed_coll(coll)
             intra.all_gather(array)
 

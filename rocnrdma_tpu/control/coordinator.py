@@ -68,7 +68,8 @@ class _Member:
     __slots__ = ("rank", "incarnation", "host", "host_key",
                  "lease_deadline", "alive", "waiting", "pending_view",
                  "counters", "hists", "wait_token", "clock_offset_ns",
-                 "clock_rtt_ns", "postmortems", "qp_reserved", "hb_last")
+                 "clock_rtt_ns", "postmortems", "qp_reserved", "hb_last",
+                 "link_health", "degraded_total")
 
     def __init__(self, rank: int, incarnation: int, host: str,
                  lease_deadline: float, host_key: Optional[str] = None):
@@ -111,6 +112,13 @@ class _Member:
         # Last accepted heartbeat instant (monotonic) — the per-member
         # state behind the optional heartbeat rate limit.
         self.hb_last = 0.0
+        # Degradation-ladder riders: this member's per-link health
+        # snapshot ({link: {peer, score, degraded, faults}}) and its
+        # rung-engagement tally — the slow-rank quarantine report,
+        # served as tdr_link_health{world=,rank=,peer=} and summed
+        # into tdr_degraded_total{world=}.
+        self.link_health: Dict[str, Dict[str, Any]] = {}
+        self.degraded_total = 0
 
 
 class _World:
@@ -118,7 +126,8 @@ class _World:
                  "epoch", "members", "ever_ready", "rebuilds",
                  "lease_expiries", "joins", "trace_req", "trace_seq",
                  "resizable", "max_size", "resizes", "weight",
-                 "qp_share", "admission_rejects", "hb_throttled")
+                 "qp_share", "admission_rejects", "hb_throttled",
+                 "grow_hold_until")
 
     def __init__(self, name: str, size: int, base_port: int,
                  qp_budget: int):
@@ -141,6 +150,10 @@ class _World:
         self.resizable = False
         self.max_size = 0  # grow ceiling (0 = unbounded)
         self.resizes = 0
+        # Batch admission: deadline (monotonic) until which a
+        # pure-growth RESIZE is held open so near-simultaneous grow
+        # joiners coalesce into ONE size+N cut. 0 = no hold pending.
+        self.grow_hold_until = 0.0
         # ---- Admission control ----
         self.weight = 1.0   # fair-share weight (first join's ``weight``)
         self.qp_share = qp_budget  # computed fair share (gauge)
@@ -171,7 +184,8 @@ class Coordinator:
                  restore: bool = False,
                  hb_min_interval_ms: int = 0,
                  scrape_min_interval_ms: int = 0,
-                 max_worlds: int = 0):
+                 max_worlds: int = 0,
+                 grow_hold_ms: int = 250):
         self.host = host
         self.lease_ms = int(lease_ms)
         self.port_base = int(port_base)
@@ -184,6 +198,11 @@ class Coordinator:
         self.qp_fair = bool(qp_fair)
         self.qp_floor = int(qp_floor)
         self.max_worlds = int(max_worlds)
+        # Batch admission: how long a pure-growth RESIZE is held open
+        # so a burst of grow joiners lands in ONE size+N view change
+        # instead of N back-to-back rebuild-equivalent cuts. 0 keeps
+        # the immediate-cut behavior.
+        self._grow_hold_s = max(0.0, int(grow_hold_ms) / 1000.0)
         self._hb_min_s = max(0.0, int(hb_min_interval_ms) / 1000.0)
         self._scrape_min_s = max(0.0,
                                  int(scrape_min_interval_ms) / 1000.0)
@@ -462,6 +481,17 @@ class Coordinator:
             # world keeps waiting for the missing slots to rejoin.
             if not (w.resizable and w.ever_ready and len(alive) >= 2):
                 return
+            if w.grow_hold_until and \
+                    set(range(w.size)) <= {m.rank for m in alive}:
+                # Pure growth (every nominal slot alive, the only
+                # mismatch is parked grow joiners): hold the RESIZE
+                # open for the coalescing window so near-simultaneous
+                # joiners land in one size+N cut — the sweeper calls
+                # back when the hold expires. A shrink (dead nominal
+                # slot) never waits.
+                if time.monotonic() < w.grow_hold_until:
+                    return
+                w.grow_hold_until = 0.0
             self._apply_resize(w)
             alive = w.alive_members()
         w.epoch += 1
@@ -574,6 +604,14 @@ class Coordinator:
                     # has parked too.
                     rank = max(w.members, default=w.size - 1) + 1
                     grow = True
+                    if self._grow_hold_s > 0.0 and \
+                            w.grow_hold_until < time.monotonic():
+                        # First grow joiner of a burst opens the
+                        # coalescing window; later ones ride it (never
+                        # extend — a steady trickle must not starve
+                        # the cut).
+                        w.grow_hold_until = \
+                            time.monotonic() + self._grow_hold_s
                 else:
                     # Admission backpressure: a full fleet is a
                     # RETRYABLE condition with a deterministic
@@ -702,6 +740,20 @@ class Coordinator:
                         setattr(m, attr, int(v))
                     except (TypeError, ValueError):
                         pass
+            # Degradation-ladder riders: the member's per-link health
+            # snapshot and rung-engagement tally (the quarantine
+            # report behind tdr_link_health / tdr_degraded_total).
+            lh = req.get("link_health")
+            if isinstance(lh, dict):
+                m.link_health = {str(k): dict(st)
+                                 for k, st in lh.items()
+                                 if isinstance(st, dict)}
+            dt = req.get("degraded_total")
+            if dt is not None:
+                try:
+                    m.degraded_total = int(dt)
+                except (TypeError, ValueError):
+                    pass
             resp = {"ok": True, "generation": w.generation,
                     "stale": int(req.get("generation", -1)) != w.generation}
             # RESIZE hint: membership no longer matches the nominal
@@ -843,6 +895,11 @@ class Coordinator:
                         # world_size-1 view here instead of timing out.
                         self._maybe_release(w)
                         self._cv.notify_all()
+                    if w.grow_hold_until and now >= w.grow_hold_until:
+                        # A batch-admission hold ran out with everyone
+                        # parked: cut the coalesced grow view now.
+                        self._maybe_release(w)
+                        self._cv.notify_all()
 
     # ------------------------------------------------------- snapshots
 
@@ -891,6 +948,8 @@ class Coordinator:
                     "clock_rtt_ns": m.clock_rtt_ns,
                     "postmortems": m.postmortems,
                     "qp_reserved": m.qp_reserved,
+                    "link_health": m.link_health,
+                    "degraded_total": m.degraded_total,
                 } for m in w.members.values()],
             }
         return {
@@ -977,6 +1036,12 @@ class Coordinator:
                 m.clock_rtt_ns = int(md.get("clock_rtt_ns", 0))
                 m.postmortems = int(md.get("postmortems", 0))
                 m.qp_reserved = int(md.get("qp_reserved", 0))
+                lh = md.get("link_health")
+                if isinstance(lh, dict):
+                    m.link_health = {str(k): dict(st)
+                                     for k, st in lh.items()
+                                     if isinstance(st, dict)}
+                m.degraded_total = int(md.get("degraded_total", 0))
                 w.members[m.rank] = m
             self._worlds[w.name] = w
         trace.add("ctl.failover", 1)
@@ -1070,6 +1135,27 @@ class Coordinator:
                                  f"{m.clock_offset_ns / 1000.0:.6g}")
                     lines.append(f"tdr_clock_rtt_us{rlab} "
                                  f"{m.clock_rtt_ns / 1000.0:.6g}")
+                # Slow-rank quarantine report (heartbeat-pushed ladder
+                # scores): WHICH link each member's degradation ladder
+                # is acting on, plus the world's rung-engagement tally.
+                # Contract-pinned names (tests/test_control.py):
+                # tdr_link_health{world=,rank=,peer=} and
+                # tdr_degraded_total{world=}.
+                for m in sorted(w.members.values(), key=lambda m: m.rank):
+                    for link in sorted(m.link_health):
+                        st = m.link_health[link]
+                        try:
+                            score = float(st.get("score", 1.0))
+                            peer = int(st.get("peer", -1))
+                        except (TypeError, ValueError):
+                            continue
+                        lines.append(
+                            f'tdr_link_health{{world="{name}",'
+                            f'rank="{m.rank}",peer="{peer}",'
+                            f'link="{link}"}} {score:.6g}')
+                lines.append(
+                    f"tdr_degraded_total{lab} "
+                    f"{sum(m.degraded_total for m in w.members.values())}")
                 # Member-pushed counter registry, summed over each
                 # slot's CURRENT occupant — dead or departed members
                 # keep serving their last snapshot (a scraper must not

@@ -196,6 +196,21 @@ int tdr_qp_has_seal_payload(tdr_qp *qp);
  * the pre-trace-id framing. */
 int tdr_qp_has_coll_id(tdr_qp *qp);
 
+/* Hung-peer probe: send a zero-byte PING (sealed with a tag-only CRC
+ * on sealed connections) and wait up to timeout_ms for the peer's
+ * progress engine to PONG it back. Returns 1 = peer alive, 0 = no
+ * pong within the timeout (peer hung/wedged), -1 = connection down,
+ * -2 = uninformative (backend has no probe, or FEAT_PROBE was not
+ * negotiated — with it off, frames stay byte-identical to the legacy
+ * wire format; TDR_NO_PROBE=1 disables the advertisement). */
+int tdr_qp_probe(tdr_qp *qp, int timeout_ms);
+
+/* Stamp the QP's link identity (channel lane, local rank, peer rank)
+ * so netem fault riders can scope to one link and stall/probe
+ * telemetry names the edge. The ring layer calls this at channel
+ * bring-up; -1 = unknown. Purely observational. */
+void tdr_qp_set_link(tdr_qp *qp, int lane, int rank, int peer);
+
 /* ------------------------------------------------------------------ *
  * Flight recorder — the engine-side telemetry subsystem.
  *
@@ -255,6 +270,13 @@ enum {
                               boundaries ride thread timing, so they
                               must not perturb per-engine replay
                               shapes. */
+  TDR_TEL_FAULT = 20,      /* netem rider fired on an outbound frame
+                              (delay/jitter sleep, throttle pacing
+                              wait, duplicate, or reorder hold):
+                              id=frame seq, arg=bytes. Emitted once
+                              per frame however many riders matched;
+                              the per-clause hit counters carry the
+                              breakdown. */
 };
 
 /* Histograms. Recorded at log-linear ("log2 × 8") resolution: 8

@@ -73,6 +73,14 @@ int tdr_qp_has_coll_id(tdr_qp *qp) {
   return reinterpret_cast<Qp *>(qp)->has_coll_id() ? 1 : 0;
 }
 
+int tdr_qp_probe(tdr_qp *qp, int timeout_ms) {
+  return reinterpret_cast<Qp *>(qp)->probe(timeout_ms);
+}
+
+void tdr_qp_set_link(tdr_qp *qp, int lane, int rank, int peer) {
+  reinterpret_cast<Qp *>(qp)->set_link(lane, rank, peer);
+}
+
 tdr_engine *tdr_engine_open(const char *spec) {
   std::string s = spec ? spec : "auto";
   std::string err;

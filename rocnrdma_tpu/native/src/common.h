@@ -162,6 +162,29 @@ class Qp {
   // trace id to the peer; emu only, and only when both ends were
   // recording at handshake time).
   virtual bool has_coll_id() const { return false; }
+  // Link identity for fault riders and health attribution: the ring
+  // layer stamps (lane, self rank, peer rank) at channel bring-up so
+  // netem clauses can scope to one link and the probe/stall telemetry
+  // names the edge. -1 = unstamped (control QPs, direct API users).
+  std::atomic<int> link_lane{-1};
+  std::atomic<int> link_rank{-1};
+  std::atomic<int> link_peer{-1};
+  void set_link(int lane, int rank, int peer) {
+    link_lane.store(lane, std::memory_order_relaxed);
+    link_rank.store(rank, std::memory_order_relaxed);
+    link_peer.store(peer, std::memory_order_relaxed);
+  }
+  // Hung-peer probe: send a zero-byte PING on this connection and wait
+  // up to timeout_ms for the peer's progress engine to PONG it back.
+  // Returns 1 (peer alive), 0 (no pong within the timeout — peer hung
+  // or wedged), -1 (connection down), -2 (uninformative: the backend
+  // has no probe or FEAT_PROBE was not negotiated). The stall
+  // escalation path treats -2 as "no new information" and keeps the
+  // legacy stall verdict.
+  virtual int probe(int timeout_ms) {
+    (void)timeout_ms;
+    return -2;
+  }
   virtual int poll(tdr_wc *wc, int max, int timeout_ms) = 0;
   virtual int close_qp() = 0;
 };
@@ -288,6 +311,13 @@ enum : uint32_t {
   // with the feature off, frames are byte-identical to the
   // pre-trace-id wire format (acceptance-pinned).
   FEAT_COLL_ID = 1u << 4,
+  // Hung-peer probe frames (OP_PING/OP_PONG, zero-byte, sealed with a
+  // tag-only CRC on sealed connections). Frame-changing — an
+  // un-negotiated peer's parser would misread the new opcodes — so it
+  // is negotiated exactly like FEAT_COLL_ID: with the feature off,
+  // frames stay byte-identical to the legacy wire format
+  // (TDR_NO_PROBE acts at the advertising stage).
+  FEAT_PROBE = 1u << 5,
 };
 
 // Locally-willing feature set (TDR_NO_FOLDBACK / TDR_NO_FUSED2 act
@@ -334,6 +364,32 @@ void fault_totals(uint64_t *seen, uint64_t *hits);
 uint64_t fault_total_hits();
 uint64_t fault_total_seen();
 
+// Netem-style riders (fault.cc): delay/jitter, reorder, dup and
+// throttle clauses scoped per link (chunk + lane + rank/peer + tier).
+// Evaluated at frame-transmission time by the emu send path. The
+// returned action says what the sender must do; delay_us already
+// includes deterministic jitter and throttle pacing. Reorder is
+// two-phase for counter honesty: fault_netem RESERVES the hold (the
+// clause's seen advances, hits does not) and hands back a commit key;
+// the sender calls fault_netem_commit once the held frame's fate is
+// known — swapped=true (a later frame overtook it: the injection
+// happened, hits++) or swapped=false (flushed in original order: the
+// reservation is refunded so a later frame can still be reordered).
+struct NetemAction {
+  long long delay_us = 0;  // total pre-transmit sleep (delay+jitter+pace)
+  bool dup = false;        // transmit a duplicate after this frame
+  bool reorder = false;    // hold this frame behind its successor
+  int reorder_clause = -1; // commit key: clause index
+  uint64_t plan_gen = 0;   // commit key: plan generation at reserve time
+};
+// tier_cma: 1 = CMA/desc tier, 0 = stream tier. Returns true when any
+// rider matched (action populated).
+bool fault_netem(long long chunk, int tier_cma, int lane, int rank,
+                 int peer, unsigned long long bytes, NetemAction *out);
+void fault_netem_commit(int clause_idx, uint64_t plan_gen, bool swapped);
+// Fast-path gate: any netem clause armed at all (parse-time constant).
+bool fault_netem_armed();
+
 // CRC32C (Castagnoli), hardware-accelerated when the build has
 // SSE4.2, table-driven otherwise. Incremental: seed with the previous
 // return value to extend a running checksum (crc32c(b, crc32c(a, 0))
@@ -352,6 +408,30 @@ enum SealCounter {
 void seal_count(int which);
 uint64_t seal_counter(int which);
 void seal_counters_reset();
+
+// Process-wide hung-peer probe counters (util.cc): pings sent, pongs
+// received, probes that timed out — surfaced through the native
+// counter registry so the health ladder and /metrics observe the
+// probe traffic without a side channel.
+enum ProbeCounter {
+  kProbeSent = 0,
+  kProbePong = 1,
+  kProbeTimeout = 2,
+};
+void probe_count(int which);
+uint64_t probe_counter(int which);
+
+// Deterministic 64-bit mix (splitmix64 finalizer): the seeded-jitter
+// primitive shared by the netem delay rider and the NAK backoff —
+// same inputs, same jitter, on every run (no rand()).
+uint64_t mix64(uint64_t x);
+
+// Per-collective hard deadline (TDR_COLL_DEADLINE_MS, 0 = disabled):
+// unlike the soft stall clock — which re-arms on every completion —
+// this bounds the WHOLE collective, so a link that crawls while still
+// making progress eventually escalates instead of starving training
+// forever.
+int coll_deadline_ms();
 
 // Per-chunk retransmit budget (TDR_SEAL_RETRY, default 3, clamped to
 // [0, 100]): how many NAK-driven re-posts a receiver requests before

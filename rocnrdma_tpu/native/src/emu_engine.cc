@@ -32,10 +32,12 @@
 #include <algorithm>
 #include <chrono>
 #include <condition_variable>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -80,6 +82,16 @@ enum WireOp : uint8_t {
   // inflight MR ref until the final ack, so the source cannot be
   // reclaimed while retransmissions are possible.
   OP_NAK = 14,
+  // Hung-peer probe (FEAT_PROBE, negotiated like FEAT_COLL_ID so
+  // legacy frames stay byte-identical): a zero-byte PING answered by
+  // the peer's PROGRESS THREAD with a PONG echoing the token in aux.
+  // A pong proves the peer process is alive and draining its socket —
+  // distinguishing "alive but slow" (degrade) from "gone/frozen"
+  // (escalate) at the stall site. Sealed connections append a
+  // tag-only trailer (CRC over the tag + steering fields; there is no
+  // payload).
+  OP_PING = 15,
+  OP_PONG = 16,
 };
 
 // Seal: CRC32C over the payload, then extended over the (generation,
@@ -517,6 +529,10 @@ struct PendingOp {
   // the completion's WC event keep reporting the ORIGINAL collective
   // whatever the QP's cur_coll has advanced to.
   uint64_t coll = 0;
+  // NAK count for this op: drives the adaptive retransmit backoff
+  // (exponential with deterministic jitter) — a corrupt storm backs
+  // off instead of melting into a NAK/retx busy loop.
+  uint32_t naks = 0;
 };
 
 // RAII pair for EmuEngine::landing_begin: guarantees the inflight ref
@@ -815,7 +831,49 @@ class EmuQp : public Qp {
 
   bool has_coll_id() const override { return coll_wire_; }
 
+  // Hung-peer probe: PING the peer's PROGRESS THREAD and wait for the
+  // echoed PONG. A pong proves the peer process is alive and draining
+  // its socket even though the collective is stalled — "slow, degrade"
+  // rather than "gone, escalate". Sealed connections carry a tag-only
+  // trailer on both frames. Returns 1 alive, 0 no pong (hung), -1
+  // connection down, -2 not negotiated (legacy peer / TDR_NO_PROBE —
+  // frames stay byte-identical with the feature off).
+  int probe(int timeout_ms) override {
+    if (!(features_ & FEAT_PROBE)) return -2;
+    uint64_t token;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (dead_) return -1;
+      token = ++probe_token_;
+    }
+    FrameHdr h{};
+    h.op = OP_PING;
+    h.aux = token;
+    probe_count(kProbeSent);
+    bool sent;
+    if (seal_) {
+      SealTrailer t{};
+      t.cseq = static_cast<uint32_t>(token);
+      t.crc = seal_crc(t, h, nullptr, 0);
+      sent = send_frame(h, nullptr, 0, &t);
+    } else {
+      sent = send_frame(h, nullptr, 0);
+    }
+    if (!sent) return -1;
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms < 0 ? 0 : timeout_ms),
+                 [&] { return dead_ || pong_token_ >= token; });
+    if (pong_token_ >= token) return 1;
+    if (dead_) return -1;
+    probe_count(kProbeTimeout);
+    return 0;
+  }
+
   int poll(tdr_wc *wc, int max, int timeout_ms) override {
+    // Stale reorder-hold flush rides the poll path: by the time a
+    // driver is polling with nothing left to send, a held last frame
+    // has no swap partner coming.
+    netem_poll_flush();
     std::unique_lock<std::mutex> lk(mu_);
     if (cq_.empty() && timeout_ms != 0) {
       auto pred = [this] { return !cq_.empty() || dead_; };
@@ -835,6 +893,12 @@ class EmuQp : public Qp {
   int close_qp() override {
     bool expected = false;
     if (!closing_.compare_exchange_strong(expected, true)) return 0;
+    {
+      // A reorder-held frame must precede the GOODBYE (original
+      // order — its swap never happened, so the reservation refunds).
+      std::lock_guard<std::mutex> g(send_mu_);
+      flush_held_locked(/*swapped=*/false);
+    }
     FrameHdr h{};
     h.op = OP_GOODBYE;
     send_frame(h, nullptr, 0);
@@ -1030,7 +1094,7 @@ class EmuQp : public Qp {
   bool read_and_verify_trailer(const FrameHdr &h, char *data, uint64_t len,
                                bool *ok_out) {
     SealTrailer t{};
-    if (!read_full(fd_, &t, sizeof(t))) return false;
+    if (!rd(&t, sizeof(t))) return false;
     long long nb = fault_corrupt("land", static_cast<long long>(h.seq));
     if (nb > 0 && data && len) {
       size_t n = std::min<size_t>(static_cast<size_t>(nb),
@@ -1098,7 +1162,7 @@ class EmuQp : public Qp {
     (void)guard;
     tel(TDR_TEL_LAND, r.wr_id, len, r.coll);
     if (!r.is_reduce) {
-      if (!read_full(fd_, r.dst, len)) return false;
+      if (!rd(r.dst, len)) return false;
     } else {
       const size_t esz = dtype_size(r.dtype);
       char window[64 << 10];
@@ -1107,7 +1171,7 @@ class EmuQp : public Qp {
       uint64_t left = len;
       while (left > 0) {
         size_t chunk = left < step ? static_cast<size_t>(left) : step;
-        if (!read_full(fd_, window, chunk)) return false;
+        if (!rd(window, chunk)) return false;
         reduce_any(dst, window, chunk / esz, r.dtype, r.red_op);
         dst += chunk;
         left -= chunk;
@@ -1291,8 +1355,61 @@ class EmuQp : public Qp {
     } else {
       if (!write_full(fd_, &h, hb)) return false;
     }
-    if (trailer) return write_full(fd_, trailer, sizeof(*trailer));
-    return true;
+    if (trailer && !write_full(fd_, trailer, sizeof(*trailer))) return false;
+    // Any frame leaving after a reorder-held one is its swap partner:
+    // the held frame follows it out, completing the injection.
+    return flush_held_locked(/*swapped=*/true);
+  }
+
+  // ---- Netem sender riders -----------------------------------------
+  // A reorder-held frame lives here, fully serialized, until a
+  // successor frame overtakes it (flush under send_mu_ right after
+  // that frame's bytes) or a stale-hold flush releases it in original
+  // order. One-deep by construction.
+
+  std::string serialize_frame(const FrameHdr &h, const char *payload,
+                              size_t len, const SealTrailer *t) {
+    size_t hb = coll_wire_ ? sizeof(FrameHdr) : kFrameHdrWireBase;
+    std::string f;
+    f.reserve(hb + len + (t ? sizeof(*t) : 0));
+    f.append(reinterpret_cast<const char *>(&h), hb);
+    if (payload && len) f.append(payload, len);
+    if (t) f.append(reinterpret_cast<const char *>(t), sizeof(*t));
+    return f;
+  }
+
+  // Flush the held frame (caller holds send_mu_). swapped=true when a
+  // later frame overtook it — the reorder injection happened and its
+  // clause's hit counter advances; false when it leaves in original
+  // order (stale flush, close, teardown) — the reservation refunds so
+  // the counters never claim a reorder that did not occur.
+  bool flush_held_locked(bool swapped) {
+    if (held_.empty()) return true;
+    std::string f = std::move(held_);
+    held_.clear();
+    held_flag_.store(false, std::memory_order_release);
+    fault_netem_commit(held_clause_, held_gen_, swapped);
+    held_clause_ = -1;
+    bool dup = held_dup_;
+    held_dup_ = false;
+    if (!write_full(fd_, f.data(), f.size())) return false;
+    return !dup || write_full(fd_, f.data(), f.size());
+  }
+
+  // Stale-hold flush (called from poll, off the send path): a held
+  // frame whose swap partner never came — the collective's last frame
+  // — must still leave, or the peer waits on it until its stall
+  // clock fires. 1ms grace keeps a hot send loop winning the swap.
+  void netem_poll_flush() {
+    if (!held_flag_.load(std::memory_order_acquire)) return;
+    std::lock_guard<std::mutex> g(send_mu_);
+    if (held_.empty()) return;
+    uint64_t now = static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count());
+    if (now - held_at_ns_ < 1000000ull) return;
+    flush_held_locked(/*swapped=*/false);
   }
 
   // Seal-aware frame submission for every payload-bearing request
@@ -1306,31 +1423,83 @@ class EmuQp : public Qp {
   bool send_frame_sealed(FrameHdr h, const char *src, size_t len, bool desc,
                          uint64_t wr_id) {
     tel(TDR_TEL_WIRE_TX, h.seq, len, h.coll);
-    if (!seal_)
-      return desc ? send_frame(h, nullptr, 0) : send_frame(h, src, len);
-    SealTrailer t{};
-    t.gen = static_cast<uint32_t>(eng_->seal_gen());
-    t.step = static_cast<uint32_t>(eng_->seal_step());
-    t.cseq = static_cast<uint32_t>(h.seq);
-    // Tag-only mode (CMA tier default): the CRC covers the tag and
-    // the steering fields, not the payload — both ends agreed on the
-    // coverage at handshake time, so verification stays symmetric.
-    t.crc = seal_payload_ ? seal_crc(t, h, src, len)
-                          : seal_crc(t, h, nullptr, 0);
-    seal_count(kSealSealed);
-    long long nb = fault_corrupt(
-        "send", static_cast<long long>(wr_id & 0xffffffffffffull));
-    if (nb <= 0)
-      return desc ? send_frame(h, nullptr, 0, &t)
-                  : send_frame(h, src, len, &t);
-    if (desc) {
-      t.crc ^= 0xffffffffu;
-      return send_frame(h, nullptr, 0, &t);
+    // Netem riders fire at frame-transmission time, scoped by the
+    // link identity the ring stamped. The delay (delay/jitter rider +
+    // throttle pacing) sleeps OUTSIDE send_mu_ so the progress
+    // thread's acks/pongs keep flowing while this frame crawls.
+    NetemAction act{};
+    if (fault_netem_armed()) {
+      bool fired =
+          fault_netem(static_cast<long long>(wr_id & 0xffffffffffffull),
+                      cma_ ? 1 : 0, link_lane.load(std::memory_order_relaxed),
+                      link_rank.load(std::memory_order_relaxed),
+                      link_peer.load(std::memory_order_relaxed), len, &act);
+      if (fired) tel(TDR_TEL_FAULT, h.seq, len, h.coll);
+      // Retransmissions bypass the receiver's ordering gate (their seq
+      // sits below the watermark by design), so dup/reorder must not
+      // touch them — a duplicated retx would land twice. Delay and
+      // throttle still apply: a slow wire is slow for retx too.
+      if (h.status != 0) {
+        if (act.reorder)
+          fault_netem_commit(act.reorder_clause, act.plan_gen, false);
+        act.reorder = false;
+        act.dup = false;
+      }
+      if (act.delay_us > 0)
+        std::this_thread::sleep_for(std::chrono::microseconds(act.delay_us));
     }
-    std::vector<char> wire(src, src + len);
-    size_t n = std::min<size_t>(static_cast<size_t>(nb), len);
-    for (size_t i = 0; i < n; i++) wire[i] ^= static_cast<char>(0xff);
-    return send_frame(h, wire.data(), len, &t);
+    SealTrailer t{};
+    const char *wire_src = desc ? nullptr : src;
+    size_t wire_len = desc ? 0 : len;
+    std::vector<char> wire;
+    if (seal_) {
+      t.gen = static_cast<uint32_t>(eng_->seal_gen());
+      t.step = static_cast<uint32_t>(eng_->seal_step());
+      t.cseq = static_cast<uint32_t>(h.seq);
+      // Tag-only mode (CMA tier default): the CRC covers the tag and
+      // the steering fields, not the payload — both ends agreed on the
+      // coverage at handshake time, so verification stays symmetric.
+      t.crc = seal_payload_ ? seal_crc(t, h, src, len)
+                            : seal_crc(t, h, nullptr, 0);
+      seal_count(kSealSealed);
+      long long nb = fault_corrupt(
+          "send", static_cast<long long>(wr_id & 0xffffffffffffull));
+      if (nb > 0) {
+        if (desc) {
+          t.crc ^= 0xffffffffu;
+        } else {
+          // Corrupt the WIRE copy only — the source stays intact so a
+          // NAK-driven retransmission can be clean.
+          wire.assign(src, src + len);
+          size_t n = std::min<size_t>(static_cast<size_t>(nb), len);
+          for (size_t i = 0; i < n; i++) wire[i] ^= static_cast<char>(0xff);
+          wire_src = wire.data();
+        }
+      }
+    }
+    if (!act.dup && !act.reorder)
+      return send_frame(h, wire_src, wire_len, seal_ ? &t : nullptr);
+    // Dup/reorder need the frame as one reusable byte string.
+    std::string f =
+        serialize_frame(h, wire_src, wire_len, seal_ ? &t : nullptr);
+    std::lock_guard<std::mutex> g(send_mu_);
+    if (act.reorder && held_.empty()) {
+      held_ = std::move(f);
+      held_clause_ = act.reorder_clause;
+      held_gen_ = act.plan_gen;
+      held_dup_ = act.dup;
+      held_at_ns_ = static_cast<uint64_t>(
+          std::chrono::duration_cast<std::chrono::nanoseconds>(
+              std::chrono::steady_clock::now().time_since_epoch())
+              .count());
+      held_flag_.store(true, std::memory_order_release);
+      return true;
+    }
+    if (act.reorder)  // one-deep hold: refund and transmit in order
+      fault_netem_commit(act.reorder_clause, act.plan_gen, false);
+    if (!write_full(fd_, f.data(), f.size())) return false;
+    if (act.dup && !write_full(fd_, f.data(), f.size())) return false;
+    return flush_held_locked(/*swapped=*/true);
   }
 
   // Recv completions reach the CQ in posted-ticket order: a chunk
@@ -1432,7 +1601,7 @@ class EmuQp : public Qp {
       ok = h.len == 0 ||
            par_cma_copy_from(peer_pid_, buf.data(), h.aux, h.len);
     } else {
-      if (h.len && !read_full(fd_, buf.data(), h.len)) return false;
+      if (h.len && !rd(buf.data(), h.len)) return false;
       ok = true;
     }
     if (!ok) buf.clear();
@@ -1488,7 +1657,7 @@ class EmuQp : public Qp {
       // Materialize the stream payload up front (it is consumed from
       // the socket either way; a doomed fold still must drain it).
       u.payload.resize(h.len);
-      if (h.len && !read_full(fd_, u.payload.data(), h.len)) return false;
+      if (h.len && !rd(u.payload.data(), h.len)) return false;
     }
     PostedRecv r{};
     bool have = false;
@@ -1530,7 +1699,7 @@ class EmuQp : public Qp {
         return false;
       }
       SealTrailer t{};
-      if (!read_full(fd_, &t, sizeof(t))) {
+      if (!rd(&t, sizeof(t))) {
         release_recv(r);
         return false;
       }
@@ -1557,13 +1726,13 @@ class EmuQp : public Qp {
       if (desc) {
         moved = h.len == 0 ||
                 par_cma_copy_from(peer_pid_, r.dst, h.aux, h.len);
-      } else if (h.len && !read_full(fd_, r.dst, h.len)) {
+      } else if (h.len && !rd(r.dst, h.len)) {
         conn_ok = false;
       }
       if (conn_ok) {
         if (!moved) {
           SealTrailer t{};  // raw: no verify accounting for CMA errors
-          if (!read_full(fd_, &t, sizeof(t))) conn_ok = false;
+          if (!rd(&t, sizeof(t))) conn_ok = false;
         } else if (!read_and_verify_trailer(h, r.dst, h.len, &verified)) {
           conn_ok = false;
         }
@@ -1667,7 +1836,7 @@ class EmuQp : public Qp {
       moved = h.len == 0 ||
               par_cma_copy_from(peer_pid_, buf.data(), h.aux, h.len);
     } else {
-      if (h.len && !read_full(fd_, buf.data(), h.len)) return false;
+      if (h.len && !rd(buf.data(), h.len)) return false;
       moved = true;
     }
     bool verified = false;
@@ -1678,7 +1847,7 @@ class EmuQp : public Qp {
       // integrity.failed / clause hit counters would report a
       // corruption that never happened.
       SealTrailer t{};
-      if (!read_full(fd_, &t, sizeof(t))) return false;
+      if (!rd(&t, sizeof(t))) return false;
     } else if (!read_and_verify_trailer(h, buf.data(), h.len, &verified)) {
       return false;
     }
@@ -1869,7 +2038,7 @@ class EmuQp : public Qp {
     if (!dst) {
       if (!desc && !drain(h.len)) return false;
       SealTrailer t{};
-      if (!read_full(fd_, &t, sizeof(t))) return false;
+      if (!rd(&t, sizeof(t))) return false;
       ack.status = TDR_WC_REM_ACCESS_ERR;
       return send_frame(ack, nullptr, 0);
     }
@@ -1878,7 +2047,7 @@ class EmuQp : public Qp {
     if (desc) {
       moved = par_cma_copy_from(peer_pid_, dst, h.aux, h.len);
     } else {
-      if (!read_full(fd_, dst, h.len)) {
+      if (!rd(dst, h.len)) {
         EmuEngine::dma_done(tmr);
         return false;
       }
@@ -1887,7 +2056,7 @@ class EmuQp : public Qp {
     if (!moved) {
       EmuEngine::dma_done(tmr);
       SealTrailer t{};
-      if (!read_full(fd_, &t, sizeof(t))) return false;
+      if (!rd(&t, sizeof(t))) return false;
       ack.status = TDR_WC_GENERAL_ERR;
       return send_frame(ack, nullptr, 0);
     }
@@ -1929,7 +2098,7 @@ class EmuQp : public Qp {
   // recv consumption. Returns false on connection loss.
   bool read_and_verify_tag(const FrameHdr &h, bool *ok_out) {
     SealTrailer t{};
-    if (!read_full(fd_, &t, sizeof(t))) return false;
+    if (!rd(&t, sizeof(t))) return false;
     bool ok = seal_crc(t, h, nullptr, 0) == t.crc &&
               t.cseq == static_cast<uint32_t>(h.seq);
     uint64_t local = eng_->seal_gen();
@@ -2232,12 +2401,79 @@ class EmuQp : public Qp {
     return send_frame(ack, nullptr, 0);
   }
 
+  // ---- Netem receiver gate -----------------------------------------
+  // Fresh request frames carry the sender's monotone seq; TCP delivers
+  // transmission order, which the reorder/dup riders deliberately
+  // perturb. The gate restores POST order: early frames are staged
+  // (whole wire bytes) and replayed through the pushback buffer once
+  // the gap fills; frames below the watermark are rider duplicates and
+  // drop here. Handlers never see either case, so every landing,
+  // seal-verify, and recv-match path runs on in-order traffic — the
+  // bitwise-parity-under-chaos guarantee. Zero-cost on healthy wires:
+  // frames arrive exactly at the watermark and fall straight through.
+
+  // Progress-thread read: drain the pushback buffer (replayed staged
+  // frames) before the socket.
+  bool rd(void *p, size_t n) {
+    if (!rdbuf_.empty()) {
+      size_t take = rdbuf_.size() < n ? rdbuf_.size() : n;
+      memcpy(p, rdbuf_.data(), take);
+      rdbuf_.erase(0, take);
+      if (take == n) return true;
+      return read_full(fd_, static_cast<char *>(p) + take, n - take);
+    }
+    return read_full(fd_, p, n);
+  }
+
+  static bool gate_is_request(uint8_t op) {
+    switch (op) {
+      case OP_WRITE:
+      case OP_WRITE_DESC:
+      case OP_SEND:
+      case OP_SEND_DESC:
+      case OP_SEND_FB:
+      case OP_SEND_FB_DESC:
+      case OP_READ_REQ:
+      case OP_READ_REQ_DESC:
+        return true;
+      default:
+        return false;
+    }
+  }
+
+  // Wire bytes that FOLLOW a request frame's header.
+  uint64_t request_body_len(const FrameHdr &h) const {
+    uint64_t n = 0;
+    if (h.op == OP_WRITE || h.op == OP_SEND || h.op == OP_SEND_FB)
+      n = h.len;
+    if (seal_ && h.op != OP_READ_REQ && h.op != OP_READ_REQ_DESC)
+      n += sizeof(SealTrailer);
+    return n;
+  }
+
+  // Stage an early frame: header + body, verbatim, keyed by seq.
+  bool stage_frame(const FrameHdr &h) {
+    uint64_t body = request_body_len(h);
+    // A runaway gap is a protocol error, not a rider (the rider holds
+    // at most one frame): bound the staging memory.
+    if (staged_.size() >= 64 || body > (64ull << 20)) return false;
+    size_t hb = coll_wire_ ? sizeof(FrameHdr) : kFrameHdrWireBase;
+    std::string f;
+    f.reserve(hb + static_cast<size_t>(body));
+    f.append(reinterpret_cast<const char *>(&h), hb);
+    size_t off = f.size();
+    f.resize(off + static_cast<size_t>(body));
+    if (body && !rd(&f[off], static_cast<size_t>(body))) return false;
+    staged_.emplace(h.seq, std::move(f));
+    return true;
+  }
+
   // Drain len payload bytes we cannot place (bad rkey etc.).
   bool drain(uint64_t len) {
     char scratch[65536];
     while (len > 0) {
       size_t chunk = len < sizeof(scratch) ? len : sizeof(scratch);
-      if (!read_full(fd_, scratch, chunk)) return false;
+      if (!rd(scratch, chunk)) return false;
       len -= chunk;
     }
     return true;
@@ -2245,14 +2481,39 @@ class EmuQp : public Qp {
 
   void progress_loop() {
     FrameHdr h;
-    while (read_full(fd_, &h, kFrameHdrWireBase)) {
+    for (;;) {
+      // Replay a staged frame whose turn has come: its verbatim wire
+      // bytes re-enter through the pushback buffer, so it flows
+      // through the normal read-dispatch path below.
+      if (!staged_.empty()) {
+        auto it = staged_.find(gate_expect_);
+        if (it != staged_.end()) {
+          rdbuf_.insert(0, it->second);
+          staged_.erase(it);
+        }
+      }
+      if (!rd(&h, kFrameHdrWireBase)) break;
       // FEAT_COLL_ID extension: the trace-id word follows the base
       // header on every frame of this connection (length agreed at
       // handshake — never guessed per frame).
       if (coll_wire_) {
-        if (!read_full(fd_, &h.coll, sizeof(h.coll))) break;
+        if (!rd(&h.coll, sizeof(h.coll))) break;
       } else {
         h.coll = 0;
+      }
+      // Netem receiver gate: fresh requests re-enter sender post
+      // order; duplicates drop. Retransmissions (status != 0) bypass —
+      // their seq sits below the watermark by design.
+      if (gate_is_request(h.op) && h.status == 0) {
+        if (h.seq < gate_expect_) {
+          if (!drain(request_body_len(h))) break;  // rider duplicate
+          continue;
+        }
+        if (h.seq > gate_expect_) {
+          if (!stage_frame(h)) break;  // early: wait for the gap
+          continue;
+        }
+        gate_expect_++;
       }
       if (tel_on()) {
         switch (h.op) {
@@ -2283,7 +2544,7 @@ class EmuQp : public Qp {
           ack.op = OP_WRITE_ACK;
           ack.seq = h.seq;
           if (dst) {
-            bool ok = read_full(fd_, dst, h.len);
+            bool ok = rd(dst, h.len);
             EmuEngine::dma_done(tmr);
             if (!ok) goto out;
             ack.status = TDR_WC_SUCCESS;
@@ -2432,6 +2693,26 @@ class EmuQp : public Qp {
             }
           }
           if (have) {
+            uint32_t attempt = 0;
+            {
+              std::lock_guard<std::mutex> g(mu_);
+              auto it = pending_.find(h.seq);
+              if (it != pending_.end()) attempt = ++it->second.naks;
+            }
+            // Adaptive retransmit backoff: the first NAK re-posts
+            // immediately (one bit flip heals at full speed); repeat
+            // NAKs back off exponentially (100us doubling to 6.4ms)
+            // with deterministic seeded jitter, so a corrupt storm
+            // cannot melt into a NAK/retx busy loop yet replays
+            // identically run-to-run (TDR_REBUILD_SEED convention).
+            if (attempt > 1) {
+              uint64_t base = 100ull << std::min(attempt - 2, 6u);
+              uint64_t j = mix64(nak_seed_ ^
+                                 (h.seq * 0x9e3779b97f4a7c15ull) ^ attempt) %
+                           (base / 2 + 1);
+              std::this_thread::sleep_for(
+                  std::chrono::microseconds(base + j));
+            }
             seal_count(kSealRetx);
             tel(TDR_TEL_RETX, h.seq, p.len, p.coll);
             FrameHdr rh{};
@@ -2478,7 +2759,7 @@ class EmuQp : public Qp {
             bool can = st == TDR_WC_SUCCESS && dst && h.len == want &&
                        eng_->landing_begin(pmr);
             if (can) {
-              bool ok = read_full(fd_, dst, h.len);
+              bool ok = rd(dst, h.len);
               if (ok && seal_) {
                 // The write-back is a landing too: verify the folded
                 // bytes before the exchange completes. No retransmit
@@ -2495,7 +2776,7 @@ class EmuQp : public Qp {
               if (!drain(h.len)) goto out;
               if (seal_) {
                 SealTrailer t{};
-                if (!read_full(fd_, &t, sizeof(t))) goto out;
+                if (!rd(&t, sizeof(t))) goto out;
               }
               if (st == TDR_WC_SUCCESS) st = TDR_WC_LOC_ACCESS_ERR;
             }
@@ -2525,7 +2806,7 @@ class EmuQp : public Qp {
           if (st == TDR_WC_SUCCESS && h.len) {  // stream tier payload
             bool can = dst && h.len == want && eng_->landing_begin(pmr);
             if (can) {
-              bool ok = read_full(fd_, dst, h.len);
+              bool ok = rd(dst, h.len);
               EmuEngine::dma_done(pmr);
               if (!ok) goto out;
             } else {
@@ -2536,6 +2817,45 @@ class EmuQp : public Qp {
           complete_pending(h.seq, st, nullptr, 0);
           break;
         }
+        case OP_PING: {
+          // Hung-peer probe (FEAT_PROBE): reply OP_PONG echoing the
+          // token so the prober can tell "alive but slow" from "gone".
+          // Zero-byte frames; sealed connections add a tag-only
+          // trailer so a corrupted probe is dropped, not trusted.
+          if (!(features_ & FEAT_PROBE)) goto out;
+          if (seal_) {
+            SealTrailer t{};
+            if (!rd(&t, sizeof(t))) goto out;
+            if (seal_crc(t, h, nullptr, 0) != t.crc) break;
+          }
+          FrameHdr pong{};
+          pong.op = OP_PONG;
+          pong.aux = h.aux;
+          pong.coll = h.coll;
+          if (seal_) {
+            SealTrailer t2{};
+            t2.cseq = static_cast<uint32_t>(h.aux);
+            t2.crc = seal_crc(t2, pong, nullptr, 0);
+            if (!send_frame(pong, nullptr, 0, &t2)) goto out;
+          } else {
+            if (!send_frame(pong, nullptr, 0)) goto out;
+          }
+          break;
+        }
+        case OP_PONG: {
+          if (seal_) {
+            SealTrailer t{};
+            if (!rd(&t, sizeof(t))) goto out;
+            if (seal_crc(t, h, nullptr, 0) != t.crc) break;
+          }
+          probe_count(kProbePong);
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            if (h.aux > pong_token_) pong_token_ = h.aux;
+          }
+          cv_.notify_all();
+          break;
+        }
         case OP_GOODBYE:
           goto out;
         default:
@@ -2543,6 +2863,19 @@ class EmuQp : public Qp {
       }
     }
   out:
+    // A frame held back by a reorder rider must not leak its counter
+    // reservation when the connection dies with the swap never
+    // happening: refund it (swapped=false keeps hits truthful).
+    {
+      std::lock_guard<std::mutex> g(send_mu_);
+      if (!held_.empty()) {
+        fault_netem_commit(held_clause_, held_gen_, /*swapped=*/false);
+        held_.clear();
+        held_clause_ = -1;
+        held_dup_ = false;
+        held_flag_.store(false, std::memory_order_release);
+      }
+    }
     // Connection gone: flush every in-flight op and pending recv, the
     // RC flush semantics (TDR_WC_FLUSH_ERR). Recv flushes route
     // through the ticket map so completions withheld behind a parked
@@ -2621,6 +2954,39 @@ class EmuQp : public Qp {
   bool coll_wire_ = false;
 
   std::mutex send_mu_;  // serializes frame submission on the socket
+
+  // Netem reorder rider: at most one serialized frame held back under
+  // send_mu_ until the next frame passes it (or poll()/close flushes
+  // it). held_flag_ is the lock-free fast-path check for poll().
+  std::string held_;
+  int held_clause_ = -1;
+  uint64_t held_gen_ = 0;
+  bool held_dup_ = false;
+  uint64_t held_at_ns_ = 0;
+  std::atomic<bool> held_flag_{false};
+
+  // Netem receiver ordering gate (progress thread only): staged whole
+  // wire frames keyed by seq, replayed through the rd() pushback
+  // buffer once the watermark catches up. Fresh request frames all
+  // draw from the sender's single next_seq_ counter, so one watermark
+  // restores posted order across every request class.
+  std::string rdbuf_;
+  std::map<uint64_t, std::string> staged_;
+  uint64_t gate_expect_ = 1;
+
+  // Hung-peer probe tokens (guarded by mu_; pong wakes cv_).
+  uint64_t probe_token_ = 0;
+  uint64_t pong_token_ = 0;
+
+  // NAK-backoff jitter seed: deterministic per TDR_REBUILD_SEED (the
+  // seeded-rng convention) so retransmit storms replay identically.
+  const uint64_t nak_seed_ = [] {
+    uint64_t s = 0x9e3779b97f4a7c15ull;
+    if (const char *env = getenv("TDR_REBUILD_SEED"))
+      for (const char *p = env; *p; ++p)
+        s = mix64(s ^ static_cast<uint64_t>(static_cast<unsigned char>(*p)));
+    return s;
+  }();
 
   std::mutex mu_;  // guards cq_, pending_, recvs_, unexpected_,
                    // parked_, retx_attempts_, and the ticket state

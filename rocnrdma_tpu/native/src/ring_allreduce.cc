@@ -481,6 +481,13 @@ tdr_ring *tdr_ring_create_channels(tdr_engine *e, tdr_qp *const *lefts,
   r->rank = rank;
   r->world = world;
   r->chunk = ring_chunk_bytes();
+  // Stamp link identity on every channel QP: netem riders scope by
+  // (lane, rank, peer) and stall/health attribution reads the same
+  // labels. Ring neighbors: left = rank-1, right = rank+1 (mod world).
+  for (int c = 0; c < channels; c++) {
+    tdr_qp_set_link(r->lefts[c], c, rank, (rank + world - 1) % world);
+    tdr_qp_set_link(r->rights[c], c, rank, (rank + 1) % world);
+  }
   return r;
 }
 
@@ -865,16 +872,84 @@ int order_fail(ProgressHub &hub, const char *label, const char *what,
 // schedule is blocked, not just that it is.
 struct StallClock {
   std::chrono::steady_clock::time_point dl;
-  StallClock() { bump(); }
+  // Hard per-collective deadline (TDR_COLL_DEADLINE_MS): unlike the
+  // stall deadline it does NOT re-arm on progress — it bounds the
+  // whole collective, so a link crawling under netem delay/throttle
+  // that never quite stalls still trips it. Disabled (the default)
+  // when the env knob is unset.
+  std::chrono::steady_clock::time_point hard_dl;
+  bool hard = false;
+  StallClock() {
+    int cd = tdr::coll_deadline_ms();
+    if (cd > 0) {
+      hard = true;
+      hard_dl = std::chrono::steady_clock::now() +
+                std::chrono::milliseconds(cd);
+    }
+    bump();
+  }
   void bump() {
     dl = std::chrono::steady_clock::now() +
          std::chrono::milliseconds(ring_timeout_ms());
   }
   bool expired() const { return std::chrono::steady_clock::now() >= dl; }
+  bool deadline_exceeded() const {
+    return hard && std::chrono::steady_clock::now() >= hard_dl;
+  }
 };
 
-int stall_fail(const char *label, const std::string &detail) {
-  tdr::set_error(std::string(label) + ": poll timeout (" + detail + ")");
+// Hung-peer classification at expiry time: PING both ring neighbors
+// (channel 0 — all channels reach the same peer processes) and fold
+// the verdicts, worst first: a hung peer (probe sent, no pong inside
+// the window) outranks a dead connection outranks "alive but slow".
+// -2 = probing not negotiated anywhere (legacy peer / TDR_NO_PROBE),
+// in which case error messages stay byte-identical to the pre-probe
+// wording.
+int stall_probe(tdr_ring *r) {
+  int to = ring_timeout_ms() / 4;
+  if (to < 50) to = 50;
+  if (to > 2000) to = 2000;
+  int verdict = -2;
+  tdr_qp *qps[2] = {r->lefts[0], r->rights[0]};
+  auto rank_of = [](int v) {
+    return v == 0 ? 3 : v == -1 ? 2 : v == 1 ? 1 : 0;
+  };
+  for (tdr_qp *q : qps) {
+    if (!q) continue;
+    int pr = tdr_qp_probe(q, to);
+    if (rank_of(pr) > rank_of(verdict)) verdict = pr;
+  }
+  return verdict;
+}
+
+// Verdict suffix appended to stall/deadline errors. The markers are
+// load-bearing: the Python taxonomy keys retryability and `kind` off
+// "peer hung" / "connection down" / plain timeout (see engine.py).
+void append_probe_verdict(std::string *msg, int verdict) {
+  if (verdict == 0)
+    *msg += "; peer hung (probe unanswered)";
+  else if (verdict == -1)
+    *msg += "; peer connection down";
+  else if (verdict == 1)
+    *msg += "; peer alive (slow link)";
+  // -2: keep the legacy message byte-identical.
+}
+
+int stall_fail(tdr_ring *r, const char *label, const std::string &detail) {
+  std::string msg =
+      std::string(label) + ": poll timeout (" + detail + ")";
+  append_probe_verdict(&msg, r ? stall_probe(r) : -2);
+  tdr::set_error(msg);
+  return -1;
+}
+
+int deadline_fail(tdr_ring *r, const char *label,
+                  const std::string &detail) {
+  std::string msg = std::string(label) + ": collective deadline exceeded (" +
+                    std::to_string(tdr::coll_deadline_ms()) + "ms; " +
+                    detail + ")";
+  append_probe_verdict(&msg, r ? stall_probe(r) : -2);
+  tdr::set_error(msg);
   return -1;
 }
 
@@ -1005,6 +1080,8 @@ int drive_sharded(tdr_ring *r, S &s, ProgressHub &hub, size_t nshards,
       }
       if (s.finished_locked()) return 0;
     }
+    if (clock.deadline_exceeded())
+      return deadline_fail(r, label, s.stall_detail());
     int p = s.post_more();
     if (p < 0) return -1;
     if (p > 0) {
@@ -1024,7 +1101,7 @@ int drive_sharded(tdr_ring *r, S &s, ProgressHub &hub, size_t nshards,
       clock.bump();
       continue;
     }
-    if (clock.expired()) return stall_fail(label, s.stall_detail());
+    if (clock.expired()) return stall_fail(r, label, s.stall_detail());
   }
 }
 
@@ -1371,6 +1448,8 @@ struct StepPipe {
           clock.bump();
         }
       }
+      if (clock.deadline_exceeded())
+        return deadline_fail(r, "ring", stall_detail());
       int p = post_more();
       if (p < 0) return -1;
       int nl = sweep_side(r->lefts, *this, true);
@@ -1407,7 +1486,7 @@ struct StepPipe {
           fold_moved = folded != last_folded;
         }
         if (!fold_moved && clock.expired())
-          return stall_fail("ring", "fold stall; " + stall_detail());
+          return stall_fail(r, "ring", "fold stall; " + stall_detail());
         continue;
       }
       // Nothing postable, nothing completed: block a slice on the
@@ -1426,7 +1505,7 @@ struct StepPipe {
         fold_moved = folded != last_folded;
       }
       if (!fold_moved && clock.expired())
-        return stall_fail("ring", stall_detail());
+        return stall_fail(r, "ring", stall_detail());
     }
     return 0;
   }
@@ -1739,6 +1818,8 @@ struct FusedTwo {
         std::lock_guard<std::mutex> g(hub.mu);
         if (finished_locked()) break;
       }
+      if (clock.deadline_exceeded())
+        return deadline_fail(r, "ring(fused2)", stall_detail());
       int p = post_more();
       if (p < 0) return -1;
       int nl = sweep_side(r->lefts, *this, true);
@@ -1756,7 +1837,7 @@ struct FusedTwo {
         continue;
       }
       if (clock.expired())
-        return stall_fail("ring(fused2)", stall_detail());
+        return stall_fail(r, "ring(fused2)", stall_detail());
     }
     return 0;
   }
@@ -1992,6 +2073,8 @@ struct Wavefront {
         std::lock_guard<std::mutex> g(hub.mu);
         if (finished_locked()) break;
       }
+      if (clock.deadline_exceeded())
+        return deadline_fail(r, "ring(wave)", stall_detail());
       int p = post_more();
       if (p < 0) return -1;
       int nl = sweep_side(r->lefts, *this, true);
@@ -2008,7 +2091,7 @@ struct Wavefront {
         clock.bump();
         continue;
       }
-      if (clock.expired()) return stall_fail("ring(wave)", stall_detail());
+      if (clock.expired()) return stall_fail(r, "ring(wave)", stall_detail());
     }
     return 0;
   }

@@ -124,6 +124,7 @@ const char *kEventNames[] = {
     "wire_tx",    "wire_rx",   "land",      "verify_ok",  "verify_fail",
     "nak",        "retx",      "fold",      "wc",         "copy_enq",
     "copy_run",   "ring_begin", "ring_end", "fold_off",   "shard",
+    "fault_injected",
 };
 constexpr int kEventCount =
     static_cast<int>(sizeof(kEventNames) / sizeof(kEventNames[0]));
@@ -300,7 +301,8 @@ const char *kCounterNames[] = {
     "copy.nt_bytes",      "copy.plain_bytes",   "telemetry.recorded",
     "telemetry.dropped",  "fold.jobs",          "fold.busy_us",
     "fold.pending",       "progress.shards",    "progress.wakeups",
-    "progress.wc",
+    "progress.wc",        "probe.sent",         "probe.pong",
+    "probe.timeout",
 };
 constexpr int kRegistryCount =
     static_cast<int>(sizeof(kCounterNames) / sizeof(kCounterNames[0]));
@@ -320,6 +322,7 @@ void read_all(uint64_t out[kRegistryCount]) {
   out[11] = tdr::fold_busy_us();
   out[12] = tdr::fold_pending();
   tdr::progress_counters(&out[13], &out[14], &out[15]);
+  for (int i = 0; i < 3; i++) out[16 + i] = tdr::probe_counter(i);
 }
 
 }  // namespace

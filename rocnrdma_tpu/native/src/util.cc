@@ -41,6 +41,24 @@ int ring_timeout_ms() {
   return 30000;
 }
 
+int coll_deadline_ms() {
+  const char *env = getenv("TDR_COLL_DEADLINE_MS");
+  if (env && *env) {
+    long long v = atoll(env);
+    if (v >= 1 && v <= 86400000) return static_cast<int>(v);
+  }
+  return 0;
+}
+
+uint64_t mix64(uint64_t x) {
+  // splitmix64 finalizer (Steele/Lea/Flood) — the shared deterministic
+  // jitter mix for netem delay and NAK backoff.
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
 uint32_t local_features() {
   uint32_t f = 0;
   if (!env_set("TDR_NO_FOLDBACK") && !env_set("TDR_NO_FUSED2"))
@@ -57,6 +75,11 @@ uint32_t local_features() {
   // to the pre-trace-id format (the one-branch-guard contract's wire
   // counterpart).
   if (tel_on()) f |= FEAT_COLL_ID;
+  // Hung-peer probe frames (OP_PING/OP_PONG): on by default — a probe
+  // is observational and its frames appear only when the stall
+  // escalation path asks for them — but TDR_NO_PROBE drops the
+  // advertisement so legacy-wire tests can pin byte-identical frames.
+  if (!env_set("TDR_NO_PROBE")) f |= FEAT_PROBE;
   return f;
 }
 
@@ -138,6 +161,21 @@ uint64_t seal_counter(int which) {
 
 void seal_counters_reset() {
   for (auto &c : g_seal_counters) c.store(0, std::memory_order_relaxed);
+}
+
+// Hung-peer probe counters: same process-wide discipline as the seal
+// counters — the health ladder reads them through the registry.
+static std::atomic<uint64_t> g_probe_counters[3];
+
+void probe_count(int which) {
+  if (which >= 0 && which < 3)
+    g_probe_counters[which].fetch_add(1, std::memory_order_relaxed);
+}
+
+uint64_t probe_counter(int which) {
+  return (which >= 0 && which < 3)
+             ? g_probe_counters[which].load(std::memory_order_relaxed)
+             : 0;
 }
 
 size_t dtype_size(int dt) {

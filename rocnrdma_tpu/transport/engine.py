@@ -225,6 +225,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_qp_has_seal_payload.argtypes = [P]
     lib.tdr_qp_has_coll_id.restype = ctypes.c_int
     lib.tdr_qp_has_coll_id.argtypes = [P]
+    lib.tdr_qp_probe.restype = ctypes.c_int
+    lib.tdr_qp_probe.argtypes = [P, ctypes.c_int]
+    lib.tdr_qp_set_link.restype = None
+    lib.tdr_qp_set_link.argtypes = [P, ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int]
     lib.tdr_ring_register.restype = ctypes.c_int
     lib.tdr_ring_register.argtypes = [P, P, ctypes.c_size_t]
     lib.tdr_ring_unregister.restype = ctypes.c_int
@@ -347,6 +352,8 @@ _RETRYABLE_MARKERS = (
     "stale ring generation",  # fenced previous-incarnation traffic
     "never connected",    # rendezvous peer missing
     "ring destroyed",     # teardown raced a pending async handle
+    "deadline exceeded",  # hard per-collective deadline
+    "peer hung",          # probe sent, no pong — wedged peer process
 )
 
 
@@ -385,10 +392,16 @@ class TransportError(RuntimeError):
     def kind(self) -> str:
         """Coarse failure class: ``"integrity"`` for detected payload
         corruption / stale-incarnation fences (retryable via the
-        elastic ladder), ``"transport"`` for everything else."""
-        if self.status == WC_INTEGRITY_ERR or \
-                "integrity" in str(self).lower():
+        elastic ladder), ``"hung"`` for a peer that stopped answering
+        probes while its connection stayed up (distinct from a
+        conn-drop: the process exists but is wedged — postmortems
+        should look at the PEER, not the wire), ``"transport"`` for
+        everything else."""
+        low = str(self).lower()
+        if self.status == WC_INTEGRITY_ERR or "integrity" in low:
             return "integrity"
+        if "peer hung" in low:
+            return "hung"
         return "transport"
 
 
@@ -848,6 +861,24 @@ class QueuePair:
         """Flight-recorder track id of this QP (bring-up ordinal;
         names the per-QP timeline in Perfetto exports)."""
         return int(_load().tdr_tel_qp_id(_live(self._h, "telemetry_id")))
+
+    def probe(self, timeout_ms: int = 1000) -> int:
+        """Hung-peer probe: PING the peer's progress thread and wait
+        for the echoed PONG. Returns 1 (peer alive — it drained its
+        socket even if the collective is stalled), 0 (no pong inside
+        the window — peer hung), -1 (connection down), or -2 (probing
+        not negotiated: legacy peer or TDR_NO_PROBE — wire frames stay
+        byte-identical with the feature off)."""
+        return int(_load().tdr_qp_probe(_live(self._h, "probe"),
+                                        int(timeout_ms)))
+
+    def set_link(self, lane: int, rank: int, peer: int) -> None:
+        """Stamp link identity (channel lane, local rank, peer rank)
+        onto this QP: netem fault riders scope by these labels and
+        stall attribution reports them. Ring bring-up stamps them
+        natively; this is for QPs used outside a ring."""
+        _load().tdr_qp_set_link(_live(self._h, "set_link"),
+                                int(lane), int(rank), int(peer))
 
     def poll(self, max_wc: int = 16, timeout_ms: int = -1) -> List[Completion]:
         arr = (Wc * max_wc)()

@@ -531,6 +531,100 @@ def test_metrics_per_member_rank_labels(coord):
         assert agg == sum(pushed), name
 
 
+def test_link_health_metrics_contract(coord):
+    """Quarantine reporting: the ladder state a member pushes with its
+    heartbeat is served under the CONTRACT-PINNED names and label
+    shapes — tdr_link_health{world=,rank=,peer=,link=} per member per
+    link, tdr_degraded_total{world=} as the fleet-wide rung tally, and
+    the probe counters bridged from the native registry."""
+    for k in ("probe.sent", "probe.pong", "probe.timeout"):
+        assert k in native_counters(), k  # the bridge exports them
+    client = ControlClient(coord.address)
+    views = _join_all(client, "w", 2)
+    client.heartbeat(
+        "w", 0, views[0]["incarnation"], views[0]["generation"],
+        counters={"probe.sent": 5, "probe.pong": 4, "probe.timeout": 1},
+        link_health={
+            "inter:r0": {"peer": 1, "score": 0.42, "degraded": 1,
+                         "faults": 2},
+            "intra:r0": {"peer": -1, "score": 0.97, "degraded": 0,
+                         "faults": 0},
+        },
+        degraded_total=2)
+    client.heartbeat(
+        "w", 1, views[1]["incarnation"], views[1]["generation"],
+        link_health={"inter:r1": {"peer": 0, "score": 0.9,
+                                  "degraded": 0, "faults": 0}},
+        degraded_total=1)
+    body = client.metrics()
+    assert _metric_value(
+        body,
+        'tdr_link_health{world="w",rank="0",peer="1",link="inter:r0"}'
+    ) == pytest.approx(0.42)
+    assert _metric_value(
+        body,
+        'tdr_link_health{world="w",rank="0",peer="-1",link="intra:r0"}'
+    ) == pytest.approx(0.97)
+    assert _metric_value(
+        body,
+        'tdr_link_health{world="w",rank="1",peer="0",link="inter:r1"}'
+    ) == pytest.approx(0.9)
+    # The world tally is the SUM of the members' rung engagements.
+    assert _metric_value(body, 'tdr_degraded_total{world="w"}') == 3.0
+    assert _metric_value(body,
+                         'tdr_probe_sent_total{world="w"}') == 5.0
+    assert _metric_value(body,
+                         'tdr_probe_pong_total{world="w"}') == 4.0
+    assert _metric_value(body,
+                         'tdr_probe_timeout_total{world="w"}') == 1.0
+
+
+def test_grow_admissions_coalesce_into_one_resize():
+    """Batch admission: two joiners landing inside the grow-hold
+    window ride ONE resize (one generation bump, one repack, one
+    rebuild-equivalent disruption) instead of two back-to-back."""
+    c = Coordinator(port=0, lease_ms=1500, port_base=_free_port(),
+                    grow_hold_ms=300).start()
+    try:
+        client = ControlClient(c.address)
+        views = _join_all(client, "w", 2, resizable=True)
+        jr = [None, None]
+
+        def j(i):
+            jr[i] = client.join("w", 2, rank=-1, resizable=True,
+                                timeout_s=15)
+
+        jts = [threading.Thread(target=j, args=(i,)) for i in range(2)]
+        for t in jts:
+            t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with c._cv:
+                if len(c._worlds["w"].members) == 4:
+                    break
+            time.sleep(0.02)
+        out = [None, None]
+
+        def s(r):
+            out[r] = client.sync("w", r, views[r]["incarnation"],
+                                 timeout_s=10)
+
+        ts = [threading.Thread(target=s, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for t in jts:
+            t.join()
+        assert all(v["ok"] for v in out)
+        assert all(r["ok"] for r in jr)
+        assert sorted(r["rank"] for r in jr) == [2, 3]
+        assert all(v["world_size"] == 4 for v in out + jr)
+        assert out[0]["resizes"] == 1  # ONE resize for both admissions
+    finally:
+        c.stop()
+
+
 def test_healthz_and_unknown_path():
     coord = Coordinator(port=0, port_base=_free_port()).start()
     try:

@@ -84,7 +84,7 @@ try:
 except TransportError as e:
     elapsed = time.monotonic() - t0
     assert elapsed < 60, f"took {elapsed}s - effectively hung"
-    print("SURVIVOR-ERRORED", str(e)[:60])
+    print("SURVIVOR-ERRORED", e.kind, str(e)[:60])
 t.join()
 os.waitpid(pid, 0)
 """)
@@ -92,6 +92,9 @@ os.waitpid(pid, 0)
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
     assert ("SURVIVOR-ERRORED" in proc.stdout
             or "COMPLETED-BEFORE-KILL" in proc.stdout)
+    # A killed peer is a CONNECTION loss, never a "hung" verdict — the
+    # taxonomy keeps dead-process and wedged-process postmortems apart.
+    assert "SURVIVOR-ERRORED hung" not in proc.stdout, proc.stdout
 
 
 def test_rank_killed_before_collective_flushes_bootstrap():
@@ -123,3 +126,81 @@ except TransportError:
     assert proc.returncode == 0, (
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
     assert "FLUSHED" in proc.stdout
+
+
+_HUNG_PEER_SCRIPT = """
+import os, signal, socket, time
+import numpy as np
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+base = s.getsockname()[1]; s.close()
+rfd, wfd = os.pipe()
+
+pid = os.fork()
+rank = 1 if pid == 0 else 0
+%(child_env)s
+from rocnrdma_tpu.collectives.world import RingWorld
+from rocnrdma_tpu.transport.engine import Engine, TransportError
+
+w = RingWorld(Engine("emu"), rank, 2, base + 100)
+if pid == 0:
+    # Child: bootstrap done (features negotiated, QPs live) — report
+    # ready, then idle. The parent freezes us BEFORE we ever enter a
+    # collective, so no data is mid-wire: the survivor's stall is a
+    # pure silent-peer stall, not a flush.
+    os.close(rfd); os.write(wfd, b"r"); os.close(wfd)
+    time.sleep(120)
+    os._exit(0)
+
+os.close(wfd)
+assert os.read(rfd, 1) == b"r"
+os.close(rfd)
+os.kill(pid, signal.SIGSTOP)   # wedge the peer: alive but frozen
+time.sleep(0.2)                # let the STOP land
+
+# Small buffer: the PING must never queue behind bulk data in the
+# peer's (frozen, finite) socket buffers.
+os.environ["TDR_RING_TIMEOUT_MS"] = "5000"
+buf = np.ones((64 << 10) // 4, dtype=np.float32)
+try:
+    try:
+        w.allreduce(buf)
+        print("UNEXPECTED-COMPLETION")
+    except TransportError as e:
+        print("STALLED", e.kind)
+        print("MSG", str(e)[:200])
+finally:
+    os.kill(pid, signal.SIGCONT)
+    os.kill(pid, signal.SIGKILL)
+    os.waitpid(pid, 0)
+"""
+
+
+def test_hung_peer_classified_distinctly_from_conn_drop():
+    """A SIGSTOPped peer — process alive, connection up, zero progress
+    — must classify as `kind == "hung"` via the zero-byte probe (PING
+    delivered, PONG never comes), which is exactly what a kill/crash
+    can never produce. Postmortems for the two diverge completely:
+    hung says "look at the PEER's stacks", conn-drop says "the process
+    died"."""
+    proc = _run(_HUNG_PEER_SCRIPT % {"child_env": ""})
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "STALLED hung" in proc.stdout, proc.stdout
+    assert "peer hung (probe unanswered)" in proc.stdout, proc.stdout
+
+
+def test_no_probe_peer_keeps_legacy_stall_message():
+    """Feature gate: the child disables FEAT_PROBE at its handshake
+    (TDR_NO_PROBE=1 post-fork, pre-import), so the pair never
+    negotiates probing and the survivor's stall surfaces EXACTLY as it
+    did before this feature existed — no verdict suffix, no "hung"
+    classification — proving probe frames are invisible to legacy
+    peers."""
+    proc = _run(_HUNG_PEER_SCRIPT % {
+        "child_env": 'if pid == 0: os.environ["TDR_NO_PROBE"] = "1"'})
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+    assert "STALLED transport" in proc.stdout, proc.stdout
+    for verdict in ("peer hung", "peer alive", "peer connection down"):
+        assert verdict not in proc.stdout, proc.stdout

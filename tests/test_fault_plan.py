@@ -259,6 +259,120 @@ def test_rebuild_budget_exhaustion_is_fatal():
     worlds[0].close()
 
 
+def test_netem_grammar_rejections(fault_plan):
+    """Netem riders are send-site shapers: any clause that smuggles
+    one elsewhere, mixes it with a status injection, or uses a link
+    filter without a netem action must die at parse time (a clause
+    that silently half-applies is the lie the counters exist to
+    prevent)."""
+    fault_plan("land:delay=1000,"              # netem only at send
+               "send:delay=1000:once=general_err,"  # no mixing
+               "send:rank=0:once=general_err,"  # link match needs netem
+               "send:tier=stream:delay=2000:1000,"  # valid: delay+jitter
+               "send:reorder=2,send:dup=1,send:throttle=8")  # valid
+    assert fault_plan_clauses() == 4
+
+
+def test_netem_delay_truthful_hits(fault_plan):
+    """`send:delay=20000`: every matched frame pays 20 ms before it
+    transmits — the wall clock proves the shaping happened, the hit
+    counter proves it happened exactly per-frame, and the payload is
+    untouched (delay shapes, never corrupts)."""
+    import time
+
+    fault_plan("send:delay=20000")
+    e = Engine("emu")
+    a, b = loopback_pair(e, _port())
+    src = np.arange(64, dtype=np.uint8)
+    inbox = np.zeros(64, dtype=np.uint8)
+    smr, rmr = e.reg_mr(src), e.reg_mr(inbox)
+    t0 = time.perf_counter()
+    got = 0
+    for i in range(4):
+        b.post_recv(rmr, 0, 64, wr_id=100 + i)
+        a.post_send(smr, 0, 64, wr_id=i)
+    for _ in range(40):
+        got += len(b.poll(max_wc=8, timeout_ms=10000))
+        if got == 4:
+            break
+    elapsed = time.perf_counter() - t0
+    assert got == 4
+    assert elapsed >= 0.06, elapsed  # 4 frames x 20 ms, serialized
+    assert fault_plan_hits(0) == 4   # one hit per matched frame
+    np.testing.assert_array_equal(inbox, src)
+    smr.deregister(); rmr.deregister()
+    a.close(); b.close()
+    e.close()
+
+
+def test_netem_reorder_dup_bitwise_parity(fault_plan, monkeypatch):
+    """The chaos-rider correctness pin: with every-2nd frame held for
+    a one-deep swap AND every-2nd frame duplicated on the wire, a
+    2-rank allreduce still lands BITWISE equal to the oracle — the
+    receiver gate re-sequences and drops dupes — with zero rebuilds,
+    and both clauses' hit counters prove the riders really fired."""
+    monkeypatch.setenv("TDR_RING_TIMEOUT_MS", "30000")
+    monkeypatch.setenv("TDR_RING_CHUNK", "8192")  # many frames to mangle
+    rebuilds0 = trace.counter("world.rebuild")
+    worlds = _local_worlds(2, _port())
+    # Armed on the LIVE world (the chaos model: a link sickens under
+    # traffic). Arming before bootstrap mangles the pre-seal handshake
+    # instead — that path surfaces as a retryable timeout and exits
+    # through the rebuild ladder, not through the receiver gate.
+    fault_plan("send:reorder=2,send:dup=2")
+    count = (256 << 10) // 4
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((2, count)).astype(np.float32)
+    expect = data[0] + data[1]
+    bufs = [data[r].copy() for r in range(2)]
+    ts = [threading.Thread(target=worlds[r].allreduce, args=(bufs[r],))
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for b in bufs:
+        assert b.tobytes() == expect.tobytes()
+    assert fault_plan_hits(0) > 0, "reorder never swapped"
+    assert fault_plan_hits(1) > 0, "dup never duplicated"
+    assert trace.counter("world.rebuild") == rebuilds0
+    for w in worlds:
+        w.close()
+
+
+def test_netem_throttle_paces(fault_plan):
+    """`send:throttle=2`: a 2 MB/s pacer budget shared by every
+    matched frame — 512 KiB of traffic cannot land in less than a
+    quarter second, and each paced frame counts one hit."""
+    import time
+
+    fault_plan("send:throttle=2")
+    e = Engine("emu")
+    a, b = loopback_pair(e, _port())
+    src = np.zeros(256 << 10, dtype=np.uint8)
+    inbox = np.zeros(256 << 10, dtype=np.uint8)
+    smr, rmr = e.reg_mr(src), e.reg_mr(inbox)
+    t0 = time.perf_counter()
+    got = 0
+    for i in range(2):
+        b.post_recv(rmr, 0, 256 << 10, wr_id=100 + i)
+        a.post_send(smr, 0, 256 << 10, wr_id=i)
+    for _ in range(40):
+        got += len(b.poll(max_wc=8, timeout_ms=10000))
+        if got == 2:
+            break
+    elapsed = time.perf_counter() - t0
+    assert got == 2
+    # The pacer's horizon starts at the first matched frame: the first
+    # rides free (no wait -> no hit, the counter never lies), the
+    # second pays its full 256 KiB / 2 MBps ~= 0.13 s budget.
+    assert elapsed >= 0.1, elapsed
+    assert fault_plan_hits(0) >= 1
+    smr.deregister(); rmr.deregister()
+    a.close(); b.close()
+    e.close()
+
+
 def test_listen_timeout_bounds_accept():
     """Engine.listen with a deadline returns (with a retryable error)
     instead of stranding a thread in accept holding the port."""

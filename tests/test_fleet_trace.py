@@ -571,3 +571,56 @@ def test_explain_synthetic_straggler_and_phases():
     assert ln["seconds"] == pytest.approx(4e-3)
     # The dropped ring taints rank 1.
     assert a["tainted_ranks"] == {"1": 7}
+
+
+def test_explain_attributes_straggler_to_degraded_link():
+    """The straggler readout must say WHY when the ladder knows: a
+    rank straggling behind a peer's degraded delegate link is a link
+    problem, not a compute problem. The python tracer's
+    health.degrade events replay into ``degraded_links`` (a heal
+    retires its degrade), the straggler line carries the
+    behind-degraded-link label, and the quarantine lines name
+    link/peer/rung/score."""
+    from rocnrdma_tpu.telemetry.recorder import events_to_wire
+    from tdr_explain import analyze_segments, render_text
+
+    MS = 1_000_000
+
+    def ring(rank, engine, begin_ms, end_ms):
+        return [TelEvent(ts_ns=begin_ms * MS, name="ring_begin",
+                         engine=engine, id=1, arg=4096, coll=5),
+                TelEvent(ts_ns=end_ms * MS, name="ring_end",
+                         engine=engine, id=1, arg=0, coll=5)]
+
+    def health_ev(ms, name, link, peer, rung, score):
+        return TelEvent(ts_ns=ms * MS, name=name, source="python",
+                        fields={"world_name": "syn", "link": link,
+                                "peer": peer, "rung": rung,
+                                "score": score})
+
+    # Rank 0 reports its delegate link to peer 1 degraded; a second
+    # link degrades and HEALS inside the window (must not survive the
+    # replay). Rank 1 — the sick link's far end — straggles.
+    r0 = ring(0, 1, 1, 10) + [
+        health_ev(2, "health.degrade", "inter:r0", 1, "fallback", 0.31),
+        health_ev(3, "health.degrade", "inter:r9", 3, "wire_down", 0.7),
+        health_ev(4, "health.heal", "inter:r9", 3, "wire_down", 0.92),
+    ]
+    r1 = ring(1, 2, 6, 10)
+    segments = {
+        "0": {"events": events_to_wire(r0), "clock_offset_ns": 0,
+              "dropped": 0},
+        "1": {"events": events_to_wire(r1), "clock_offset_ns": 0,
+              "dropped": 0},
+    }
+    a = analyze_segments(segments)
+    assert a["straggler"]["rank"] == 1
+    assert a["degraded_links"] == {
+        "0": {"inter:r0": {"peer": 1, "rung": "fallback",
+                           "score": 0.31}}}
+    text = render_text(a)
+    assert ("straggler: rank 1" in text and
+            "[behind degraded link inter:r0 reported by r0 "
+            "(rung fallback)]" in text), text
+    assert ("degraded: r0 link inter:r0 -> peer r1 "
+            "rung=fallback score=0.31") in text, text

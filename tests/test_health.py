@@ -1,0 +1,312 @@
+"""Link-health scoring and the degradation ladder (degrade, don't die).
+
+Units pin the registry's contract — goodput floor, EWMA-vs-own-peak
+scoring, streak-gated soft engagement vs immediate hard (fault)
+engagement, heal hysteresis, delegate-only schedule steering, frozen
+per-collective schedule verdicts, and the TDR_NO_DEGRADE escape hatch.
+
+The two world-8 tests are the PR's acceptance pins: a hier chaos soak
+with netem riders (delay + reorder + throttle) scoped to the delegate
+links' a->b direction must degrade hier->flat with bitwise parity and
+ZERO rebuilds, while the same brownout under TDR_NO_DEGRADE=1 must
+escalate deadline -> probe verdict -> retryable error -> rebuild.
+"""
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.collectives import health
+from rocnrdma_tpu.transport.engine import (TransportError,
+                                           fault_plan_clauses,
+                                           fault_plan_hits,
+                                           fault_plan_reset)
+from rocnrdma_tpu.utils.trace import trace
+from test_hier import KEYS8, hier_worlds, run_all
+
+W = "hw"
+MB = 1 << 20
+
+
+@pytest.fixture(autouse=True)
+def clean_health():
+    health.reset()
+    yield
+    health.reset()
+
+
+def _feed(link, mbps, n=1, peer=1, world=W):
+    """n goodput samples at `mbps` on `link` (2 MiB phases — above the
+    default observation floor)."""
+    for _ in range(n):
+        health.observe(world, link, peer, 2 * MB, (2 * MB / 1e6) / mbps)
+
+
+# ------------------------------------------------------------- units
+
+
+def test_observe_floor_ignores_small_phases(monkeypatch):
+    """Phases below TDR_HEALTH_MIN_BYTES measure latency and scheduler
+    jitter, not link bandwidth — they must not move the score."""
+    monkeypatch.setenv("TDR_HEALTH_MIN_BYTES", str(MB))
+    health.observe(W, "inter:r0", 1, 4096, 10.0)  # 0.0004 MB/s "link"
+    assert health.snapshot(W) == {}
+    assert health.score(W, "inter:r0") == 1.0
+    health.observe(W, "inter:r0", 1, 2 * MB, 0.02)
+    assert "inter:r0" in health.snapshot(W)
+
+
+def test_score_is_relative_to_own_peak(monkeypatch):
+    """No absolute MB/s threshold: the score is EWMA/peak, and the
+    peak only chases the EWMA up — a faster phase redefines healthy,
+    a slower one never does."""
+    monkeypatch.setenv("TDR_HEALTH_ALPHA", "1.0")
+    monkeypatch.setenv("TDR_HEALTH_ENGAGE_STREAK", "64")
+    _feed("inter:r0", 100, n=3)
+    assert health.score(W, "inter:r0") == 1.0
+    _feed("inter:r0", 25)
+    assert health.score(W, "inter:r0") == pytest.approx(0.25)
+    _feed("inter:r0", 200)  # new sustained best
+    assert health.score(W, "inter:r0") == 1.0
+    _feed("inter:r0", 100)  # the old "healthy" is now half speed
+    assert health.score(W, "inter:r0") == pytest.approx(0.5)
+
+
+def test_streak_gates_soft_engagement(monkeypatch):
+    """Soft (goodput) evidence engages a rung only after
+    TDR_HEALTH_ENGAGE_STREAK consecutive below-threshold samples — one
+    slow phase is scheduler noise — and a good sample resets the run.
+    Healing needs threshold + TDR_HEALTH_HEAL (hysteresis)."""
+    monkeypatch.setenv("TDR_HEALTH_ALPHA", "1.0")
+    monkeypatch.setenv("TDR_HEALTH_ENGAGE_STREAK", "3")
+    monkeypatch.setenv("TDR_HEALTH_WIRE", "0.75")
+    monkeypatch.setenv("TDR_HEALTH_FALLBACK", "0.25")
+    _feed("inter:r0", 100, n=3)
+    _feed("inter:r0", 60, n=2)  # score 0.6 < 0.75, streak 2/3
+    assert not health.wire_downgrade(W)
+    _feed("inter:r0", 100)      # good sample resets the streak
+    _feed("inter:r0", 60, n=2)
+    assert not health.wire_downgrade(W)
+    _feed("inter:r0", 60)       # third consecutive: engage
+    assert health.wire_downgrade(W)
+    assert not health.fallback_active(W)  # 0.6 > fallback rung
+    assert health.degraded_total(W) == 1
+    assert health.snapshot(W)["inter:r0"]["degraded"] == 1
+    _feed("inter:r0", 100)      # 1.0 > 0.75 + heal margin: disengage
+    assert not health.wire_downgrade(W)
+    assert health.degraded_total(W) == 1  # monotone incident count
+
+
+def test_fallback_rung_and_degraded_links(monkeypatch):
+    monkeypatch.setenv("TDR_HEALTH_ALPHA", "1.0")
+    monkeypatch.setenv("TDR_HEALTH_ENGAGE_STREAK", "1")
+    _feed("inter:r2", 100, n=3, peer=6)
+    _feed("inter:r2", 10, peer=6)  # 0.1: below both rungs at once
+    assert health.wire_downgrade(W)
+    assert health.fallback_active(W)
+    assert health.degraded_links(W) == {"inter:r2": 6}
+    assert health.degraded_total(W) == 2  # both rungs counted
+
+
+def test_intra_links_never_steer_schedule(monkeypatch):
+    """Both rungs mitigate the DELEGATE link (bf16 applies to the
+    inter payload; flat rides the intra links too), so a collapsed
+    intra score is reported but never engages a rung."""
+    monkeypatch.setenv("TDR_HEALTH_ALPHA", "1.0")
+    monkeypatch.setenv("TDR_HEALTH_ENGAGE_STREAK", "1")
+    _feed("intra:r1", 100, n=3, peer=-1)
+    _feed("intra:r1", 5, n=4, peer=-1)
+    assert not health.wire_downgrade(W)
+    assert not health.fallback_active(W)
+    assert health.degraded_links(W) == {}
+    assert health.degraded_total(W) == 0
+    # ...but observability keeps the evidence.
+    assert health.snapshot(W)["intra:r1"]["score"] < 0.1
+    health.fault(W, "intra:r1", -1, kind="stall")  # hard intra evidence
+    assert not health.fallback_active(W)
+
+
+def test_fault_hard_engages_without_history():
+    """Hard evidence (stall/deadline/hung verdicts) engages
+    immediately — no streak, no goodput history required — so a
+    post-rebuild world comes back already degraded."""
+    health.fault(W, "inter:r3", 7, kind="hung")
+    assert health.fallback_active(W)
+    assert health.wire_downgrade(W)
+    assert health.degraded_links(W) == {"inter:r3": 7}
+    snap = health.snapshot(W)["inter:r3"]
+    assert snap["faults"] == 1 and snap["score"] == 0.0
+
+
+def test_no_degrade_disables_ladder(monkeypatch):
+    """TDR_NO_DEGRADE=1: scoring continues (observability) but no
+    query reports an engaged rung and every schedule verdict is
+    'hier' — failures escalate to deadline/probe/rebuild instead."""
+    monkeypatch.setenv("TDR_NO_DEGRADE", "1")
+    assert not health.ladder_enabled()
+    health.fault(W, "inter:r0", 1, kind="stall")
+    assert not health.fallback_active(W)
+    assert not health.wire_downgrade(W)
+    assert health.schedule_verdict(W, 8) == "hier"
+    # The evidence is still recorded for /metrics and tdr_explain.
+    assert health.snapshot(W)["inter:r0"]["degraded"] == 1
+
+
+def test_schedule_verdict_frozen_and_canary_cadence(monkeypatch):
+    """One verdict per (world, collective seq), frozen at first ask —
+    rung state flipping mid-window must never split ranks across
+    hier/flat — with every TDR_HEALTH_PROBE_EVERY-th candidate a
+    'canary' that re-rides the sick link so the score can heal."""
+    monkeypatch.setenv("TDR_HEALTH_ALPHA", "1.0")
+    monkeypatch.setenv("TDR_HEALTH_PROBE_EVERY", "4")
+    health.fault(W, "inter:r0", 1, kind="stall")
+    assert health.schedule_verdict(W, 4) == "canary"
+    assert health.schedule_verdict(W, 5) == "flat"
+    assert health.schedule_verdict(W, 7) == "flat"
+    assert health.schedule_verdict(W, 8) == "canary"
+    # Heal the link: new seqs return to hier, frozen seqs replay.
+    _feed("inter:r0", 100, n=2)
+    assert not health.fallback_active(W)
+    assert health.schedule_verdict(W, 5) == "flat"   # frozen
+    assert health.schedule_verdict(W, 9) == "hier"   # fresh
+
+
+# ---------------------------------------- world-8 chaos (acceptance)
+
+
+@pytest.fixture(scope="module")
+def world8():
+    worlds = hier_worlds(8, KEYS8)
+    try:
+        yield worlds
+    finally:
+        for w in worlds:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+
+# Netem brownout scoped to the delegate links' a->b direction: the
+# inter tier rings are the only stream-tier, rank-0->peer-1 links in
+# the process (intra rings and the in-process flat ring ride the CMA
+# tier), so the flat fallback path is completely clean — exactly the
+# one-sick-delegate-direction scenario the ladder exists for.
+CHAOS_PLAN = ("send:tier=stream:rank=0:peer=1:delay=2000:1000,"
+              "send:tier=stream:rank=0:peer=1:reorder=4,"
+              "send:tier=stream:rank=0:peer=1:throttle=8")
+
+
+def _chaos_env(monkeypatch):
+    """Ladder tuning sized to the soak: the inter shard is 512 KiB
+    (floor below it), thresholds under the 2-4x scheduler jitter of
+    in-process phase timings, 2-sample streak so a short brownout
+    engages, aggressive canary cadence."""
+    monkeypatch.setenv("TDR_HEALTH_MIN_BYTES", "262144")
+    monkeypatch.setenv("TDR_HEALTH_WIRE", "0.6")
+    monkeypatch.setenv("TDR_HEALTH_FALLBACK", "0.4")
+    monkeypatch.setenv("TDR_HEALTH_ENGAGE_STREAK", "2")
+    monkeypatch.setenv("TDR_HEALTH_PROBE_EVERY", "4")
+    monkeypatch.delenv("TDR_COLL_DEADLINE_MS", raising=False)
+    monkeypatch.delenv("TDR_NO_DEGRADE", raising=False)
+
+
+def _sweep(worlds, data, expect, n):
+    for _ in range(n):
+        bufs = [data[r].copy() for r in range(len(worlds))]
+        run_all(worlds, lambda r: worlds[r].allreduce(bufs[r],
+                                                      algo="hier"))
+        for r in range(len(worlds)):
+            assert bufs[r].tobytes() == expect.tobytes(), f"rank {r}"
+
+
+def test_world8_chaos_soak_degrades_without_rebuild(world8, monkeypatch):
+    """The PR's headline gate: a world-8 hier soak with the delegate
+    direction browned out (delay+reorder+throttle) keeps BITWISE
+    parity with zero rebuilds — the ladder reroutes (hier->flat, bf16
+    wire rung on the way down) instead of escalating."""
+    _chaos_env(monkeypatch)
+    wname = world8[0].world_name
+    count = (2 * MB) // 4  # 2 MiB f32 -> 512 KiB inter shard
+    rng = np.random.default_rng(7)
+    data = rng.integers(-64, 64, (8, count)).astype(np.float32)
+    expect = data.sum(axis=0)
+    rebuilds0 = trace.counter("world.rebuild")
+    degraded0 = trace.counter("algo.degraded")
+
+    _sweep(world8, data, expect, 3)  # baseline: peaks define healthy
+    assert not health.fallback_active(wname)
+
+    monkeypatch.setenv("TDR_FAULT_PLAN", CHAOS_PLAN)
+    fault_plan_reset()
+    try:
+        for _ in range(14):
+            _sweep(world8, data, expect, 1)
+            if health.fallback_active(wname):
+                break
+        hits = sum(fault_plan_hits(i)
+                   for i in range(fault_plan_clauses()))
+        assert hits > 0                       # riders actually fired
+        assert health.fallback_active(wname)  # the ladder engaged
+        # Only delegate links may steer the schedule.
+        assert health.degraded_links(wname)
+        assert all(l.startswith("inter")
+                   for l in health.degraded_links(wname))
+        # Degraded traffic: the flat reroute (plus canaries) carries
+        # the same bits.
+        _sweep(world8, data, expect, 2)
+        assert trace.counter("algo.degraded") > degraded0
+    finally:
+        monkeypatch.delenv("TDR_FAULT_PLAN", raising=False)
+        fault_plan_reset()
+    assert trace.counter("world.rebuild") == rebuilds0  # ZERO rebuilds
+
+
+def test_world8_no_degrade_escalates_to_rebuild(world8, monkeypatch):
+    """The same brownout with the ladder disabled must walk the
+    escalation ladder instead: the per-collective deadline fires, the
+    probe classifies the peers alive-but-slow, every rank surfaces a
+    RETRYABLE error, and the world rebuilds and carries traffic."""
+    _chaos_env(monkeypatch)
+    monkeypatch.setenv("TDR_NO_DEGRADE", "1")
+    monkeypatch.setenv("TDR_COLL_DEADLINE_MS", "400")
+    count = MB // 4  # 1 MiB f32 -> 256 KiB inter shard
+    rng = np.random.default_rng(11)
+    data = rng.integers(-64, 64, (8, count)).astype(np.float32)
+    expect = data.sum(axis=0)
+    rebuilds0 = trace.counter("world.rebuild")
+    gen0 = world8[0].generation
+
+    # Every delegate frame pays 700 ms (the four inter rings brown out
+    # in parallel, so the wall cost is ~one frame): no inter ring can
+    # finish inside the 400 ms deadline, and nothing ever stalls
+    # outright (the peers stay alive — a SLOW fleet, not a dead one).
+    monkeypatch.setenv("TDR_FAULT_PLAN", "send:tier=stream:delay=700000")
+    fault_plan_reset()
+    errs = [None] * 8
+
+    def run(r):
+        try:
+            world8[r].allreduce(data[r].copy(), algo="hier")
+        except TransportError as e:
+            errs[r] = e
+
+    try:
+        run_all(world8, run)
+    finally:
+        monkeypatch.delenv("TDR_FAULT_PLAN", raising=False)
+        fault_plan_reset()
+    assert all(e is not None for e in errs), errs
+    assert all(e.retryable for e in errs), errs
+    msgs = " | ".join(str(e) for e in errs)
+    assert "deadline exceeded" in msgs, msgs
+    # Probe verdict: alive-but-slow, NOT hung and NOT a conn drop.
+    assert "peer alive (slow link)" in msgs, msgs
+    assert all(e.kind != "hung" for e in errs), msgs
+
+    # The escalation's last rung: rebuild, then clean parity.
+    monkeypatch.delenv("TDR_COLL_DEADLINE_MS", raising=False)
+    run_all(world8, lambda r: world8[r].rebuild(
+        max_attempts=8, backoff_s=0.05, timeout_ms=15000))
+    assert [w.generation for w in world8] == [gen0 + 1] * 8
+    assert trace.counter("world.rebuild") >= rebuilds0 + 8
+    _sweep(world8, data, expect, 1)

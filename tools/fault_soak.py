@@ -422,6 +422,14 @@ def main(argv=None) -> int:
                          "use it, so the bitwise parity predicate "
                          "covers delegate-rank failure + per-tier "
                          "rebuild")
+    ap.add_argument("--netem", default=None, metavar="RIDERS",
+                    help="comma list of netem riders (delay=<us>[:jit], "
+                         "reorder=N, dup=N, throttle=<MBps>) applied at "
+                         "every send site for the faulty run — "
+                         "self-healing wire chaos the parity predicate "
+                         "must absorb without a single rebuild; "
+                         "composes with --plan (given alone, it "
+                         "REPLACES the seeded rebuild-provoking plan)")
     ap.add_argument("--perfetto", default=None, metavar="PATH",
                     help="write a merged Perfetto trace of the faulty "
                          "run (ctl.* arbitration events included)")
@@ -434,6 +442,11 @@ def main(argv=None) -> int:
 
     if args.plan is not None:
         plan = args.plan
+    elif args.netem:
+        # Pure netem soak: the riders are self-healing by design, so
+        # the interesting predicate is parity WITHOUT rebuilds — don't
+        # mix in the seeded rebuild-provoking plan.
+        plan = ""
     elif args.concurrent:
         # Default plan under --concurrent: self-healing corrupt riders
         # only — a process-wide ring/conn fault could land on the
@@ -444,6 +457,10 @@ def main(argv=None) -> int:
             f":corrupt={rng.randrange(1, 5)}" for k in (1, 4))
     else:
         plan = make_fault_plan(args.seed, args.steps, args.world)
+    if args.netem:
+        riders = [r.strip() for r in args.netem.split(",") if r.strip()]
+        netem = ",".join(f"send:{r}" for r in riders)
+        plan = f"{plan},{netem}" if plan else netem
     if args.topology:
         keys = [k for k in args.topology.split(",") if k]
         if len(keys) != args.world:

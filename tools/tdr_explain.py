@@ -132,6 +132,29 @@ def analyze_segments(segments: Dict[Any, Dict[str, Any]],
         for lane in rl:
             lane_rank[lane] = r
 
+    # ---- degradation-ladder attribution: the python tracer's
+    # health.degrade / health.heal events name WHICH link the ladder
+    # acted on — a rank straggling behind (or reporting) a degraded
+    # link is a link problem, not a compute problem, and the readout
+    # should say so. Engaged state is replayed in order (a heal
+    # retires its degrade), so the map holds links still degraded at
+    # the end of the window.
+    degraded: Dict[int, Dict[str, Dict[str, Any]]] = {}
+    for r, evs in ranks.items():
+        for e in sorted(evs, key=lambda e: e.ts_ns):
+            if e.source != "python":
+                continue
+            if e.name == "health.degrade":
+                f = e.fields
+                degraded.setdefault(r, {})[str(f.get("link"))] = {
+                    "peer": int(f.get("peer", -1)),
+                    "rung": str(f.get("rung", "")),
+                    "score": f.get("score"),
+                }
+            elif e.name == "health.heal":
+                degraded.get(r, {}).pop(
+                    str(e.fields.get("link")), None)
+
     # ---- group native events by collective id, per rank ----
     by_coll: Dict[int, Dict[int, List[TelEvent]]] = {}
     for r, evs in ranks.items():
@@ -266,9 +289,32 @@ def analyze_segments(segments: Dict[Any, Dict[str, Any]],
                                for r, v in sorted(wall_sums.items())},
         },
         "links": links,
+        "degraded_links": {str(r): lm
+                           for r, lm in sorted(degraded.items()) if lm},
         "tainted_ranks": {str(r): n for r, n in sorted(tainted.items())},
     }
     return result
+
+
+def _degraded_label(rank: Optional[int],
+                    degraded: Dict[str, Dict[str, Dict[str, Any]]]
+                    ) -> str:
+    """How a straggling rank relates to the degradation ladder:
+    either it reported the degraded link itself, or it is the PEER a
+    reporter's degraded delegate link points at."""
+    if rank is None:
+        return ""
+    own = degraded.get(str(rank)) or {}
+    if own:
+        link, info = sorted(own.items())[0]
+        return (f" [degraded link {link} -> peer r{info['peer']} "
+                f"(rung {info['rung']})]")
+    for reporter, lm in sorted(degraded.items()):
+        for link, info in sorted(lm.items()):
+            if info.get("peer") == rank:
+                return (f" [behind degraded link {link} reported by "
+                        f"r{reporter} (rung {info['rung']})]")
+    return ""
 
 
 # ------------------------------------------------------- postmortems
@@ -346,12 +392,21 @@ def render_text(a: Dict[str, Any]) -> str:
                  f"{a['n_collectives']} "
                  f"({a['joinable_collectives']} joinable cross-rank)")
     st = a["straggler"]
+    deg = a.get("degraded_links") or {}
     if st["rank"] is not None:
         votes = st["votes"].get(st["rank"], 0)
         lines.append(f"straggler: rank {st['rank']} "
                      f"(arrived last in {votes} of "
                      f"{a['joinable_collectives']} joinable "
-                     f"collectives)")
+                     f"collectives)"
+                     + _degraded_label(st["rank"], deg))
+    if deg:
+        for r, lm in deg.items():
+            for link, info in sorted(lm.items()):
+                lines.append(
+                    f"degraded: r{r} link {link} -> peer "
+                    f"r{info['peer']} rung={info['rung']} "
+                    f"score={info['score']}")
     if st["wall_s_by_rank"]:
         walls = " ".join(f"r{r}={v * 1e3:.1f}ms"
                          for r, v in st["wall_s_by_rank"].items())
