@@ -464,6 +464,90 @@ def bench_trainer_overlap(quick, timeout_s=900):
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_serving(quick, timeout_s=900):
+    """Serving data-path sub-bench (the r10 tentpole): the world-2
+    continuous-batching decode over streamed weight pages
+    (tools/serve_smoke.py) in a SUBPROCESS — same isolation rationale
+    as the trainer smoke. Reports the saturation curve (requests/s and
+    p99 token latency at rising concurrency), the measured
+    prefetch-overlap fraction (wire events inside serve.compute spans
+    — best window across the sweep), streamed-vs-on-demand decode
+    throughput at top concurrency, and the heal/bitwise verdicts.
+
+    Two gate objects ride along (the r08 cores-aware convention):
+    - ``overlap_gate``: serve_prefetch_overlap_fraction >= 0.3 —
+      measured only on >= 2-core hosts; on one core compute and the
+      progress threads timeshare the core, so the fraction is
+      scheduler-bound and the bound_note documents it instead of a
+      silently failed bar;
+    - ``throughput_gate``: prefetch tokens/s >= non-prefetch — the
+      engine must never LOSE throughput to its own run-ahead; this
+      one holds on any core count (the comparison is self-relative).
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if quick:
+        # Quick mode keeps the identical engine/pager/batcher path but
+        # swaps llama-tiny's flax params for the numpy toy tree (no
+        # jax startup in the subprocess) and trims the sweep — the
+        # bench-contract suite runs this on every CI pass.
+        env["TDR_SERVE_QUICK"] = "1"
+        env["TDR_SERVE_SMOKE_LITE"] = "1"
+    # The sub-bench measures; the record gates. A 1-core host would
+    # trip the smoke's own CI bar on a noisy window, losing the whole
+    # datapoint — disarm it here and score below.
+    env.setdefault("TDR_SERVE_GATE", "0.0")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "serve_smoke.py")],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=REPO, env=env)
+        out = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("SERVE "):
+                out = json.loads(line[len("SERVE "):])
+                out["smoke_ok"] = proc.returncode == 0
+                break
+        if out is None:
+            raise RuntimeError((proc.stderr or "no SERVE line")
+                               .strip()[-300:])
+    except Exception as e:  # noqa: BLE001 — recorded, not swallowed
+        return {"error": f"{type(e).__name__}: {e}"}
+    cores = out.get("host_cores") or 1
+    frac = out.get("overlap_fraction")
+    met = bool(frac is not None and frac >= 0.3)
+    bound_note = None
+    if not met and cores < 2:
+        bound_note = (
+            "1-core host: the decode GEMVs and the wire progress "
+            "threads timeshare the single core, so the share of wire "
+            "events the scheduler lands inside serve.compute spans is "
+            "scheduler-bound, not engine-bound — gate measured only "
+            "with >= 2 usable cores (BENCH_r08 cores-aware "
+            "convention; re-scored automatically when CI regains "
+            "cores)")
+    out["overlap_gate"] = {
+        "metric": "serve_prefetch_overlap_fraction",
+        "threshold": 0.3,
+        "host_cores": cores,
+        "value": frac,
+        "met": met,
+        "bound_note": bound_note,
+    }
+    pre = out.get("prefetch_tokens_s")
+    non = out.get("noprefetch_tokens_s")
+    out["throughput_gate"] = {
+        "metric": "serve_prefetch_vs_noprefetch_tokens_s",
+        "threshold": 1.0,
+        "host_cores": cores,
+        "value": (round(pre / non, 3) if pre and non else None),
+        "met": bool(pre and non and pre >= non),
+        "bound_note": None,
+    }
+    return out
+
+
 def bench_alltoall(count=(256 << 20) // 4, world=2, iters=3):
     """Ring all-to-all per-link bandwidth: (world-1)/2 of the buffer
     crosses each link per call (bundle-shrink schedule)."""
@@ -600,7 +684,7 @@ def write_bench_record(details, bus, tel, quick, details_path):
     never clobber the repo's official trajectory point."""
     from rocnrdma_tpu.collectives.staging import staging
 
-    rnd = os.environ.get("TDR_BENCH_ROUND", "r09")
+    rnd = os.environ.get("TDR_BENCH_ROUND", "r10")
     # Saturation check (the r06 defect this round fixes): percentiles
     # that all sit on one octave edge carry no information — with the
     # fine (log2 × 8) histograms that only happens when the recording
@@ -714,6 +798,37 @@ def write_bench_record(details, bus, tel, quick, details_path):
         # Best-measured channel count + monotone flag PER WORLD SIZE
         # (the w4-only sweep hid that the knee moves with rank count).
         "channels_auto_by_world": details.get("channels_auto_by_world"),
+        # Serving data path (the r10 tentpole): the world-2 continuous-
+        # batching saturation curve (requests/s vs p99 token latency at
+        # rising concurrency), the prefetch-overlap fraction (wire
+        # events inside serve.compute spans, best window — cores-aware
+        # gate), streamed-vs-on-demand decode throughput at top
+        # concurrency (gated prefetch >= non-prefetch on ANY core
+        # count), and the heal + bitwise-token verdicts of the
+        # join/evict scenario under a corrupt rider.
+        "serve_prefetch_overlap_fraction": details.get(
+            "serving", {}).get("overlap_fraction"),
+        "serve_saturation": details.get("serving", {}).get("curve"),
+        "serve_tokens_s": {
+            "prefetch": details.get("serving", {}).get(
+                "prefetch_tokens_s"),
+            "noprefetch": details.get("serving", {}).get(
+                "noprefetch_tokens_s"),
+            # Best-of-N windows, both sides measured the same number
+            # of times (single windows on a 1-core host are noise).
+            "windows": details.get("serving", {}).get(
+                "tokens_s_windows"),
+        },
+        "serve_overlap_gate": details.get("serving", {}).get(
+            "overlap_gate"),
+        "serve_throughput_gate": details.get("serving", {}).get(
+            "throughput_gate"),
+        "serve_heal": details.get("serving", {}).get("heal"),
+        "serve_scenario": {
+            k: v for k, v in (details.get("serving", {})
+                              .get("scenario") or {}).items()
+            if k != "tokens"},
+        "serve_smoke_ok": details.get("serving", {}).get("smoke_ok"),
     }
     path = os.environ.get("TDR_BENCH_RECORD")
     if not path:
@@ -1111,6 +1226,9 @@ def main():
     # Backward-overlap trainer datapoint (the r08 tentpole): bucketed
     # async-handle train loop, wire hidden behind the backward pass.
     details["trainer_overlap"] = bench_trainer_overlap(quick)
+    # Serving data-path datapoint (the r10 tentpole): continuous-
+    # batching decode with weight/KV pages streamed ahead of compute.
+    details["serving"] = bench_serving(quick)
     if os.environ.get("TDR_BENCH_NO_TPU", "0") in ("", "0"):
         details.update(bench_tpu_details())
     else:
@@ -1154,6 +1272,10 @@ def main():
             "trainer_overlap", {}).get("overlap_fraction"),
         "hier_vs_flat_world8": details.get(
             "hier", {}).get("largest", {}).get("ratio"),
+        "serve_tokens_s": details.get(
+            "serving", {}).get("prefetch_tokens_s"),
+        "serve_prefetch_overlap_fraction": details.get(
+            "serving", {}).get("overlap_fraction"),
         "tpu": tpu[:160],
         "details_file": details_file,
         "bench_record": os.path.basename(record_path),

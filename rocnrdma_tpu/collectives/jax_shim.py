@@ -56,7 +56,6 @@ from __future__ import annotations
 import hashlib
 import os
 import time
-from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -64,6 +63,7 @@ import numpy as np
 
 from rocnrdma_tpu.collectives.staging import staging
 from rocnrdma_tpu.collectives.world import RingWorld
+from rocnrdma_tpu.serving.stream import TransferEngine, stream_depth
 from rocnrdma_tpu.hbm.registry import (HbmError, MemoryExporter,
                                        RegistrationManager, as_ndarray)
 from rocnrdma_tpu.transport.engine import (ENGINE_VERBS, RED_SUM,
@@ -182,6 +182,14 @@ class CrossSliceAllReduce:
         self._regmgr: Optional[RegistrationManager] = None
         # Worker for the staged pipeline's ring ops (lazy).
         self._stage_ex: Optional[ThreadPoolExecutor] = None
+        # The shared streaming transfer engine (serving/stream.py):
+        # every launch — zero-copy, adopted-jax, bucketed staged —
+        # goes through engine.submit(), and the pipelined staged path
+        # is engine.pipeline(). Depth 0 = credits accounted but never
+        # blocking: the trainer's natural bound is the digest-checked
+        # bucket plan; the serving pager runs the SAME engine class
+        # with a bounded gate (TDR_STREAM_DEPTH).
+        self._engine = TransferEngine(depth=0, name="xslice")
         # One-shot training-step stamp for the next schedule-digest
         # exchange (set_step_token): lets the elastic trainer verify
         # that every rank resumed at the SAME step — ranks whose
@@ -688,7 +696,8 @@ class CrossSliceAllReduce:
                 self._step_token = None
                 for va, nbytes, arr in coalesced:
                     self._ensure_registered(va, nbytes)
-                    h = self.world.allreduce_async(arr)
+                    h = self._engine.submit(
+                        lambda a=arr: self.world.allreduce_async(a))
                     launched.append(h)
                     ops.append(("zc", h, arr, va))
                     used_keys.add((va, nbytes))
@@ -697,7 +706,8 @@ class CrossSliceAllReduce:
                         va, (nbytes // np.dtype(buf.dtype).itemsize,),
                         buf.dtype)
                     self._ensure_registered(va, nbytes)
-                    h = self.world.allreduce_async(view)
+                    h = self._engine.submit(
+                        lambda v=view: self.world.allreduce_async(v))
                     launched.append(h)
                     ops.append(("jax", h, view, va))
                     used_keys.add((va, nbytes))
@@ -761,7 +771,7 @@ class CrossSliceAllReduce:
         target = wbuf if compress else buf
         for o, n, _members in segs:
             self._register_slice(reg_key, target[o:o + n])
-        for k, (o, n, members) in enumerate(segs):
+        def bucket_produce(o: int, n: int, members, k: int) -> None:
             # Bucket spans ride their own exporter lanes (lane=) so
             # the gather/wire interleaving reads as parallel bars in
             # Perfetto instead of stacking on the tracer lane.
@@ -782,15 +792,22 @@ class CrossSliceAllReduce:
                     np.subtract(seg,
                                 wbuf[o:o + n].astype(np.float32),
                                 out=res[o:o + n])
-            h = self.world.allreduce_async(target[o:o + n])
-            # Hand the core to the transport for one scheduling slot:
-            # on core-starved hosts the gather loop would otherwise
-            # monopolize the CPU between launches and the just-posted
-            # bucket's wire work would only start after the LAST
-            # gather — serializing exactly the overlap this path
-            # exists for. A real NIC is separate silicon; this yield
-            # is the 1-core stand-in (sub-µs no-op elsewhere).
-            time.sleep(0)
+
+        for k, (o, n, members) in enumerate(segs):
+            # produce (gather+compress) then launch, then yield one
+            # scheduling slot (yield_cpu): on core-starved hosts the
+            # gather loop would otherwise monopolize the CPU between
+            # launches and the just-posted bucket's wire work would
+            # only start after the LAST gather — serializing exactly
+            # the overlap this path exists for. A real NIC is separate
+            # silicon; the yield is the 1-core stand-in (sub-µs no-op
+            # elsewhere).
+            h = self._engine.submit(
+                lambda o=o, n=n: self.world.allreduce_async(
+                    target[o:o + n]),
+                produce=lambda o=o, n=n, m=members, k=k:
+                    bucket_produce(o, n, m, k),
+                yield_cpu=True, tag=("seg", k))
             launched.append(h)
             ops.append(("seg", h, (dtype_str, o, n, list(members),
                                    compress, k)))
@@ -908,37 +925,20 @@ class CrossSliceAllReduce:
         if ex is None:
             ex = self._stage_ex = ThreadPoolExecutor(
                 1, thread_name_prefix="tdr-stage")
-        pending: deque = deque()
-        # Three in flight (gathering / on the wire / scattering): one
-        # deeper than strict double-buffering so per-rank skew in the
-        # collective's rendezvous is absorbed by the queue instead of
-        # stalling the gather side.
-        depth = 3
-        try:
-            for k, seg in enumerate(segs):
-                gather(seg, k)
-                fut = ex.submit(ring_op, seg, k)
-                pending.append((fut, seg, k))
-                # Scatter the oldest segment once its reduction lands.
-                while len(pending) >= depth or (pending and
-                                                pending[0][0].done()):
-                    done_fut, done_seg, dk = pending.popleft()
-                    done_fut.result()
-                    scatter(done_seg, dk)
-            while pending:
-                done_fut, done_seg, dk = pending.popleft()
-                done_fut.result()
-                scatter(done_seg, dk)
-        except BaseException:
-            # Drain the worker so no ring op runs concurrently with
-            # the caller's error handling / teardown.
-            while pending:
-                fut, _, _ = pending.popleft()
-                try:
-                    fut.result()
-                except Exception:
-                    pass
-            raise
+        # Depth default 3 (TDR_STREAM_DEPTH): gathering / on the wire /
+        # scattering — one deeper than strict double-buffering so
+        # per-rank skew in the collective's rendezvous is absorbed by
+        # the queue instead of stalling the gather side. The engine's
+        # pipeline() IS the old deque loop, extracted: produce, submit
+        # to the worker, consume strictly in submission order, drain
+        # every future before an error propagates so no ring op runs
+        # concurrently with the caller's teardown.
+        self._engine.pipeline(
+            segs,
+            produce=gather,
+            launch=lambda seg, k: ex.submit(ring_op, seg, k),
+            consume=lambda _res, seg, k: scatter(seg, k),
+            depth=stream_depth(3))
 
     @staticmethod
     def _segment_plan(idxs: List[int], sizes: List[int],
@@ -1086,6 +1086,7 @@ class CrossSliceAllReduce:
     def close(self) -> None:
         """Release the zero-copy registrations (unadopt from the ring,
         then unpin). Call before tearing down the world."""
+        self._engine.close()
         if self._stage_ex is not None:
             self._stage_ex.shutdown(wait=True)
             self._stage_ex = None

@@ -870,14 +870,29 @@ class RingWorld:
             # staged call counts — the selector made observable on
             # /metrics as tdr_algo_*_total).
             snap.update(trace.counters_prefixed("algo."))
+            # Serving SLO counters (tdr_serve_requests_total /
+            # tdr_serve_tokens_total — the continuous batcher's
+            # request/token tallies ride the same heartbeat).
+            snap.update(trace.counters_prefixed("serve."))
             return snap
 
         def _hists():
             from rocnrdma_tpu.transport.engine import \
                 telemetry_histograms
 
-            return {name: {i: c for i, c in enumerate(buckets) if c}
-                    for name, buckets in telemetry_histograms().items()}
+            out = {name: {i: c for i, c in enumerate(buckets) if c}
+                   for name, buckets in telemetry_histograms().items()}
+            # Python-tier fine histograms (log2×8 — serving token
+            # latency). The marker bucket {64: 0} forces the
+            # coordinator's reconstructed row past 64 entries, so
+            # hist_percentile reads it with fine-octave edges while
+            # the native 64-octave rows keep their interpretation.
+            for name, row in trace.hists().items():
+                merged = out.setdefault(name, {})
+                merged.setdefault(64, 0)
+                for b, c in row.items():
+                    merged[b] = merged.get(b, 0) + c
+            return out
 
         def _trace_segment(max_events):
             # collect_trace pull: one bounded flight-recorder window

@@ -1169,8 +1169,18 @@ class Coordinator:
                     for hname, buckets in m.hists.items():
                         row = hists.setdefault(hname, [0] * 64)
                         for b, c in buckets.items():
-                            if 0 <= b < 64:
-                                row[b] += c
+                            # Native rows are 64 octave buckets;
+                            # python-tier fine (log2×8) rows carry
+                            # indices past 64 (plus a {64: 0} marker
+                            # so hist_percentile reads fine edges) —
+                            # grow the row to fit, capped well above
+                            # any real fine index (2^64 ns ≈ bucket
+                            # 488) so a corrupt push can't balloon it.
+                            if not 0 <= b < 512:
+                                continue
+                            if b >= len(row):
+                                row.extend([0] * (b + 1 - len(row)))
+                            row[b] += c
                 for k in sorted(agg):
                     lines.append(f"{self._metric_name(k)}{lab} {agg[k]}")
                 # Per-member series: the same registry counters, one

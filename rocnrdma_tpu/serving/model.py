@@ -1,0 +1,306 @@
+"""Paged numpy Llama decode — the serving-side model consumer.
+
+The training model (:mod:`rocnrdma_tpu.models.llama`) is flax/jax; the
+serving decode path is a faithful **numpy port of the same math**
+operating on flat f32 weight *pages* — one page per transformer layer
+plus an embedding page and a head page — because pages are what the
+streaming pager delivers. Keeping the hot loop in numpy does two
+things: the -san smoke can run it with no jaxlib in the process (the
+MLIR pybind trips ASan's ``__cxa_throw`` interceptor), and every
+matmul releases the GIL so the ring's async driver streams page k+1
+underneath layer k's compute — the overlap the subsystem exists to
+produce, measurable on a 1-core host.
+
+Math parity: RMSNorm, split-half RoPE, GQA with f32 accumulation,
+stable softmax, SwiGLU, f32 logits — mirroring the flax modules
+line-for-line. Greedy tokens match ``llama.generate(temperature=0)``
+(asserted in tests); the bitwise contract the smoke pins is
+streamed-pages vs local-pages on THIS port, where identity is
+structural (the wire moves exact bytes).
+
+This module never imports jax. ``pack_llama_params`` accepts the
+*already materialized* numpy param tree (the caller device_gets it),
+so full mode and LITE mode share every line below the packing seam.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .pager import PageSet
+
+__all__ = [
+    "ServeConfig", "page_names", "pack_pages", "pack_llama_params",
+    "toy_param_tree", "unpack_embed", "unpack_layer", "unpack_head",
+    "PagedDecoder",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """The architecture facts decode needs — a jax-free mirror of
+    ``LlamaConfig`` (constructible from one via :meth:`from_llama`)."""
+
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    max_seq_len: int = 128
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def from_llama(cls, cfg: Any) -> "ServeConfig":
+        return cls(vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+                   n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+                   n_kv_heads=cfg.n_kv_heads, d_ff=cfg.d_ff,
+                   max_seq_len=cfg.max_seq_len,
+                   rope_theta=cfg.rope_theta, norm_eps=cfg.norm_eps)
+
+
+# ------------------------------------------------------------- page layout
+#
+# Page k of a ServeConfig model:
+#   page 0                 : embedding        [vocab, d_model]
+#   page 1 .. n_layers     : one layer each   [attn_norm | wq | wk | wv |
+#                                              wo | mlp_norm | w_gate |
+#                                              w_up | w_down], flat f32
+#   page n_layers + 1      : head             [final_norm | lm_head]
+#
+# The layout is a pure function of the config — every rank derives the
+# identical page sizes (the pager's SPMD schedule needs nothing else).
+
+def _layer_fields(cfg: ServeConfig) -> List[Tuple[str, Tuple[int, ...]]]:
+    d, hd = cfg.d_model, cfg.head_dim
+    return [
+        ("attn_norm", (d,)),
+        ("wq", (d, cfg.n_heads * hd)),
+        ("wk", (d, cfg.n_kv_heads * hd)),
+        ("wv", (d, cfg.n_kv_heads * hd)),
+        ("wo", (cfg.n_heads * hd, d)),
+        ("mlp_norm", (d,)),
+        ("w_gate", (d, cfg.d_ff)),
+        ("w_up", (d, cfg.d_ff)),
+        ("w_down", (cfg.d_ff, d)),
+    ]
+
+
+def page_names(cfg: ServeConfig) -> List[str]:
+    return (["embed"] + [f"layer_{i}" for i in range(cfg.n_layers)]
+            + ["head"])
+
+
+def _pack(fields: Sequence[Tuple[str, Tuple[int, ...]]],
+          tensors: Dict[str, np.ndarray]) -> np.ndarray:
+    parts = []
+    for name, shape in fields:
+        t = np.ascontiguousarray(tensors[name], dtype=np.float32)
+        if tuple(t.shape) != tuple(shape):
+            raise ValueError(f"{name}: shape {t.shape} != {shape}")
+        parts.append(t.reshape(-1))
+    return np.concatenate(parts) if parts else np.zeros(0, np.float32)
+
+
+def _unpack(fields: Sequence[Tuple[str, Tuple[int, ...]]],
+            page: np.ndarray) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for name, shape in fields:
+        n = int(np.prod(shape))
+        out[name] = page[off:off + n].reshape(shape)
+        off += n
+    return out
+
+
+def pack_pages(cfg: ServeConfig, tree: Dict[str, Any]) -> PageSet:
+    """``tree`` is the nested numpy param dict (flax naming, see
+    :func:`pack_llama_params` / :func:`toy_param_tree`)."""
+    pages = [_pack([("embed", (cfg.vocab_size, cfg.d_model))],
+                   {"embed": tree["embed"]})]
+    for i in range(cfg.n_layers):
+        pages.append(_pack(_layer_fields(cfg), tree[f"layer_{i}"]))
+    pages.append(_pack(
+        [("final_norm", (cfg.d_model,)),
+         ("lm_head", (cfg.d_model, cfg.vocab_size))],
+        {"final_norm": tree["final_norm"], "lm_head": tree["lm_head"]}))
+    return PageSet(pages, page_names(cfg))
+
+
+def unpack_embed(cfg: ServeConfig, page: np.ndarray) -> np.ndarray:
+    return page[:cfg.vocab_size * cfg.d_model].reshape(
+        cfg.vocab_size, cfg.d_model)
+
+
+def unpack_layer(cfg: ServeConfig, page: np.ndarray) -> Dict[str, np.ndarray]:
+    return _unpack(_layer_fields(cfg), page)
+
+
+def unpack_head(cfg: ServeConfig, page: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    d = cfg.d_model
+    return (page[:d],
+            page[d:d + d * cfg.vocab_size].reshape(d, cfg.vocab_size))
+
+
+def pack_llama_params(cfg: ServeConfig, params: Dict[str, Any]) -> PageSet:
+    """Flatten a (materialized-to-numpy) flax ``init_params`` tree into
+    pages. ``params`` is the ``{"params": {...}}`` tree with numpy (or
+    numpy-convertible) leaves — the caller device_gets; this module
+    stays jax-free."""
+    p = params["params"] if "params" in params else params
+    tree: Dict[str, Any] = {
+        "embed": np.asarray(p["embed"]["embedding"]),
+        "final_norm": np.asarray(p["final_norm"]["weight"]),
+        "lm_head": np.asarray(p["lm_head"]["kernel"]),
+    }
+    for i in range(cfg.n_layers):
+        lp = p[f"layer_{i}"]
+        tree[f"layer_{i}"] = {
+            "attn_norm": np.asarray(lp["attn_norm"]["weight"]),
+            "wq": np.asarray(lp["attn"]["wq"]["kernel"]),
+            "wk": np.asarray(lp["attn"]["wk"]["kernel"]),
+            "wv": np.asarray(lp["attn"]["wv"]["kernel"]),
+            "wo": np.asarray(lp["attn"]["wo"]["kernel"]),
+            "mlp_norm": np.asarray(lp["mlp_norm"]["weight"]),
+            "w_gate": np.asarray(lp["mlp"]["w_gate"]["kernel"]),
+            "w_up": np.asarray(lp["mlp"]["w_up"]["kernel"]),
+            "w_down": np.asarray(lp["mlp"]["w_down"]["kernel"]),
+        }
+    return pack_pages(cfg, tree)
+
+
+def toy_param_tree(cfg: ServeConfig, seed: int = 7) -> Dict[str, Any]:
+    """Deterministic small random params (numpy RNG — identical on
+    every rank for a given seed): the LITE/-san path and the unit
+    tests, no jax in the process."""
+    rng = np.random.default_rng(seed)
+
+    def w(*shape):
+        scale = 1.0 / np.sqrt(shape[0])
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    tree: Dict[str, Any] = {
+        "embed": w(cfg.vocab_size, cfg.d_model),
+        "final_norm": np.ones(cfg.d_model, np.float32),
+        "lm_head": w(cfg.d_model, cfg.vocab_size),
+    }
+    for i in range(cfg.n_layers):
+        tree[f"layer_{i}"] = {
+            "attn_norm": np.ones(cfg.d_model, np.float32),
+            "wq": w(cfg.d_model, cfg.n_heads * cfg.head_dim),
+            "wk": w(cfg.d_model, cfg.n_kv_heads * cfg.head_dim),
+            "wv": w(cfg.d_model, cfg.n_kv_heads * cfg.head_dim),
+            "wo": w(cfg.n_heads * cfg.head_dim, cfg.d_model),
+            "mlp_norm": np.ones(cfg.d_model, np.float32),
+            "w_gate": w(cfg.d_model, cfg.d_ff),
+            "w_up": w(cfg.d_model, cfg.d_ff),
+            "w_down": w(cfg.d_ff, cfg.d_model),
+        }
+    return tree
+
+
+# ---------------------------------------------------------------- decoder
+
+def _rmsnorm(x: np.ndarray, w: np.ndarray, eps: float) -> np.ndarray:
+    ms = np.mean(x * x, axis=-1, keepdims=True)
+    return x * (1.0 / np.sqrt(ms + eps)) * w
+
+
+def _silu(x: np.ndarray) -> np.ndarray:
+    return x * (1.0 / (1.0 + np.exp(-x)))
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    m = np.max(x, axis=-1, keepdims=True)
+    e = np.exp(x - m)
+    return e / np.sum(e, axis=-1, keepdims=True)
+
+
+class PagedDecoder:
+    """Stateless per-page math; the batcher owns page acquisition and
+    per-request KV caches, this class owns the numbers.
+
+    KV caches are per-request arrays of shape
+    ``(n_kv_heads, max_seq_len, head_dim)`` f32 (``new_cache()``)."""
+
+    def __init__(self, cfg: ServeConfig) -> None:
+        self.cfg = cfg
+        hd = cfg.head_dim
+        inv = 1.0 / (cfg.rope_theta ** (
+            np.arange(0, hd, 2, dtype=np.float32) / hd))
+        t = np.arange(cfg.max_seq_len, dtype=np.float32)
+        freqs = np.outer(t, inv)                    # (S, hd/2)
+        self._cos = np.cos(freqs)
+        self._sin = np.sin(freqs)
+
+    def new_cache(self) -> Dict[str, np.ndarray]:
+        cfg = self.cfg
+        shape = (cfg.n_kv_heads, cfg.max_seq_len, cfg.head_dim)
+        return {"k": np.zeros(shape, np.float32),
+                "v": np.zeros(shape, np.float32)}
+
+    def _rope(self, x: np.ndarray, pos: int) -> np.ndarray:
+        # x: (H, s, hd) — split-half rotation, f32 throughout.
+        s = x.shape[1]
+        cos = self._cos[pos:pos + s][None]          # (1, s, hd/2)
+        sin = self._sin[pos:pos + s][None]
+        half = x.shape[-1] // 2
+        x1, x2 = x[..., :half], x[..., half:]
+        return np.concatenate(
+            [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+    def embed(self, embed_page: np.ndarray, tokens: np.ndarray
+              ) -> np.ndarray:
+        emb = unpack_embed(self.cfg, embed_page)
+        return emb[np.asarray(tokens, dtype=np.int64)]   # (s, D)
+
+    def layer(self, layer_page: np.ndarray, x: np.ndarray,
+              cache: Dict[str, np.ndarray], pos: int) -> np.ndarray:
+        """One transformer block over ``x`` (s, D) at absolute
+        position ``pos``, writing K/V into ``cache`` — the flax
+        decode branch, in numpy."""
+        cfg = self.cfg
+        w = unpack_layer(cfg, layer_page)
+        s = x.shape[0]
+        hd = cfg.head_dim
+
+        h = _rmsnorm(x, w["attn_norm"], cfg.norm_eps)
+        q = (h @ w["wq"]).reshape(s, cfg.n_heads, hd).transpose(1, 0, 2)
+        k = (h @ w["wk"]).reshape(s, cfg.n_kv_heads, hd).transpose(1, 0, 2)
+        v = (h @ w["wv"]).reshape(s, cfg.n_kv_heads, hd).transpose(1, 0, 2)
+        q = self._rope(q, pos)
+        k = self._rope(k, pos)
+        cache["k"][:, pos:pos + s] = k
+        cache["v"][:, pos:pos + s] = v
+        k_all, v_all = cache["k"], cache["v"]
+
+        rep = cfg.n_heads // cfg.n_kv_heads
+        qg = q.reshape(cfg.n_kv_heads, rep, s, hd)
+        scores = np.einsum("grqd,gkd->grqk", qg, k_all) / np.sqrt(
+            np.float32(hd))
+        q_pos = pos + np.arange(s)
+        visible = np.arange(cfg.max_seq_len)[None, :] <= q_pos[:, None]
+        scores = np.where(visible[None, None], scores, -np.inf)
+        probs = _softmax(scores)
+        o = np.einsum("grqk,gkd->grqd", probs, v_all)
+        o = o.reshape(cfg.n_heads, s, hd).transpose(1, 0, 2).reshape(
+            s, cfg.n_heads * hd)
+        x = x + o @ w["wo"]
+
+        h = _rmsnorm(x, w["mlp_norm"], cfg.norm_eps)
+        x = x + (_silu(h @ w["w_gate"]) * (h @ w["w_up"])) @ w["w_down"]
+        return x
+
+    def head(self, head_page: np.ndarray, x: np.ndarray) -> np.ndarray:
+        """Final norm + lm_head → f32 logits (s, vocab)."""
+        fn, lm = unpack_head(self.cfg, head_page)
+        return _rmsnorm(x, fn, self.cfg.norm_eps) @ lm

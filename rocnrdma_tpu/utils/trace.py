@@ -56,6 +56,7 @@ class _Tracer:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = collections.defaultdict(int)
+        self._hists: Dict[str, Dict[int, int]] = {}
         self._ring: Deque[Tuple[float, str, Dict[str, Any]]] = collections.deque(
             maxlen=_RING_CAP
         )
@@ -76,6 +77,35 @@ class _Tracer:
             return
         with self._lock:
             self._counters[name] += n
+
+    def hist(self, name: str, value: int) -> None:
+        """Record ``value`` into a log2×8 (fine-octave) histogram.
+
+        Bucket math mirrors ``telemetry.recorder.fine_bucket_upper``
+        (inlined here — utils must not import telemetry): values < 16
+        map 1:1 to buckets 0..15; above that each power-of-two octave
+        splits into 8 sub-buckets, so p99 reads stay within ~12.5 % of
+        the true value across the whole range. Serving pushes token
+        latencies through here; the heartbeat ships the sparse dict to
+        the coordinator next to the native octave histograms."""
+        v = int(value)
+        if v < 0:
+            v = 0
+        if v < 16:
+            b = v
+        else:
+            oct_ = v.bit_length()
+            sub = (v >> (oct_ - 4)) - 8
+            b = 8 + 8 * (oct_ - 4) + sub
+        with self._lock:
+            row = self._hists.setdefault(name, {})
+            row[b] = row.get(b, 0) + 1
+
+    def hists(self) -> Dict[str, Dict[int, int]]:
+        """Snapshot of all fine histograms as sparse ``{bucket: count}``
+        rows (the same shape ``world._hists`` ships natively)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._hists.items()}
 
     def counter(self, name: str) -> int:
         with self._lock:
@@ -103,6 +133,7 @@ class _Tracer:
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._hists.clear()
             self._ring.clear()
 
     @contextmanager

@@ -1,0 +1,557 @@
+"""Serving data path (ISSUE 18): streaming transfer engine, weight/KV
+page prefetch over the zero-copy path, continuous batching.
+
+Pins the contracts that make the serving subsystem safe to grow on:
+
+- **credit accounting**: the engine never holds more than
+  ``TDR_STREAM_DEPTH`` transfers in flight (high-water mark proved,
+  not assumed); a failed launch/fetch refunds its credit; teardown
+  drains to a balanced gate with a FLAT thread census (the engine
+  spawns no threads — it rides the PR 8 async driver);
+- **pager FIFO**: prefetch order is acquire order, out-of-order
+  acquires raise instead of silently serving the wrong page;
+- **sealed KV streaming**: a corrupt rider on a streamed KV page at
+  world 2 fails seal verification, NAKs, retransmits, and the
+  consumer sees bitwise the home rank's bytes;
+- **continuous batching**: mid-stream join (home-rank prefill + KV
+  page streaming) and mid-stream evict at token boundaries produce
+  tokens bitwise identical to a sequential loopback run, with and
+  without prefetch, at world 1 and 2;
+- **numpy/flax parity**: the paged numpy decoder greedy-decodes the
+  same tokens ``llama.generate`` does (the port's contract);
+- **SLO metrics**: serve.* counters and the token_lat_us fine
+  histogram ride the ordinary heartbeat and render on /metrics under
+  the contract-pinned names (``tdr_serve_requests_total`` /
+  ``tdr_serve_tokens_total`` / ``tdr_token_lat_us{quantile=}``);
+- **attribution**: request-tagged stream collective ids decompose in
+  tdr_explain per request id.
+"""
+
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from rocnrdma_tpu.collectives.world import local_worlds
+from rocnrdma_tpu.serving.batcher import ContinuousBatcher, Request
+from rocnrdma_tpu.serving.model import (PagedDecoder, ServeConfig,
+                                        pack_pages, page_names,
+                                        toy_param_tree)
+from rocnrdma_tpu.serving.pager import KVStream, PageSet, WeightStreamer
+from rocnrdma_tpu.serving.stream import (CreditGate, TransferEngine,
+                                         is_stream_coll,
+                                         make_stream_coll, stream_coll_request,
+                                         stream_coll_seq, stream_depth)
+from rocnrdma_tpu.transport.engine import (fault_plan_reset, seal_counters,
+                                           seal_counters_reset,
+                                           telemetry_reset)
+from rocnrdma_tpu.utils.trace import trace
+
+from test_transport import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+
+@pytest.fixture(autouse=True)
+def _serving_env():
+    keys = ("TDR_TELEMETRY", "TDR_FAULT_PLAN", "TDR_SEAL_CMA",
+            "TDR_STREAM_DEPTH")
+    saved = {k: os.environ.get(k) for k in keys}
+    trace.reset()
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    telemetry_reset()
+    fault_plan_reset()
+    seal_counters_reset()
+
+
+def _task_count() -> int:
+    return len(os.listdir("/proc/self/task"))
+
+
+def _toy(seed=7, **over):
+    cfg = ServeConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, d_ff=64, max_seq_len=32,
+                      rope_theta=10000.0, **over)
+    return cfg, pack_pages(cfg, toy_param_tree(cfg, seed=seed))
+
+
+# ------------------------------------------------------ coll-id encoding
+
+
+def test_stream_coll_encoding_roundtrip():
+    """Request/seq round-trip; bit 63 stays clear (the ring's
+    auto-assign bit must never collide with serving ids)."""
+    for req, seq in ((0, 1), (7, 3), ((1 << 22) - 1, (1 << 40) - 1)):
+        c = make_stream_coll(req, seq)
+        assert is_stream_coll(c)
+        assert c >> 63 == 0
+        assert stream_coll_request(c) == req
+        assert stream_coll_seq(c) == seq
+    assert not is_stream_coll(0)
+    assert not is_stream_coll(1 << 63)
+    assert not is_stream_coll((1 << 63) | (1 << 62))
+
+
+# ------------------------------------------------------ credit accounting
+
+
+def test_credit_gate_depth_and_underflow():
+    g = CreditGate(2, name="t")
+    assert g.acquire() and g.acquire()
+    assert g.in_flight == 2 and g.high_water == 2
+    assert not g.acquire(timeout_s=0.02)  # full — bounded, not broken
+    g.release()
+    assert g.acquire(timeout_s=1.0)
+    g.release()
+    g.release()
+    with pytest.raises(RuntimeError):
+        g.release()  # refunding a credit never acquired is a bug
+
+
+def test_engine_failed_launch_refunds_credit():
+    eng = TransferEngine(depth=2, name="t")
+    with pytest.raises(ValueError):
+        eng.submit(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    s = eng.stats()
+    assert s["in_flight"] == 0 and s["acquired"] == s["released"]
+    eng.close()
+
+
+def test_streamer_honors_stream_depth_env(monkeypatch):
+    """Loopback pager over many pages: the high-water mark never
+    exceeds TDR_STREAM_DEPTH, every credit is refunded, teardown is
+    thread-flat (the engine spawns none)."""
+    monkeypatch.setenv("TDR_STREAM_DEPTH", "2")
+    assert stream_depth() == 2
+    cfg, pages = _toy()
+    before = _task_count()
+    st = WeightStreamer(None, pages, name="t")
+    assert st.depth == 2
+    order = list(range(len(pages))) * 3
+    fetched = 0
+    for _ in range(st.depth):
+        st.prefetch(order[fetched] if fetched < len(order) else 0)
+        fetched += 1
+    for k, idx in enumerate(order):
+        view = st.acquire(idx)
+        np.testing.assert_array_equal(view, pages.pages[idx])
+        st.release(view)
+        if fetched < len(order):
+            st.prefetch(order[fetched])
+            fetched += 1
+    s = st.stats()
+    assert s["high_water"] <= 2, s
+    assert s["pages"] == len(order)
+    st.close()
+    s = st.stats()
+    assert s["acquired"] == s["released"] and s["in_flight"] == 0, s
+    assert _task_count() == before
+
+
+def test_streamer_fifo_contract():
+    cfg, pages = _toy()
+    st = WeightStreamer(None, pages, depth=2)
+    st.prefetch(0)
+    st.prefetch(1)
+    with pytest.raises(RuntimeError, match="FIFO"):
+        st.acquire(1)  # head of stream is page 0
+    v = st.acquire(0)
+    st.release(v)
+    with pytest.raises(RuntimeError, match="aliases no held window"):
+        st.release(np.zeros(4, np.float32))
+    st.close()
+
+
+def test_streamer_teardown_mid_stream_drains():
+    """close() with fetches in flight AND pages held: every window
+    and credit comes back, no thread leaks."""
+    cfg, pages = _toy()
+    before = _task_count()
+    st = WeightStreamer(None, pages, depth=3)
+    st.prefetch(0)
+    st.prefetch(1)
+    st.prefetch(2)
+    _held = st.acquire(0)  # held, never released by the caller
+    st.close()
+    s = st.stats()
+    assert s["acquired"] == s["released"] and s["in_flight"] == 0, s
+    assert len(st._free) == st.depth
+    assert _task_count() == before
+
+
+def test_world2_credit_refund_under_retransmit(monkeypatch):
+    """NAK/retransmit on a streamed weight page: the heal is invisible
+    to the credit ledger — the gate balances, high-water stays within
+    depth, and the landed page is bitwise right."""
+    monkeypatch.setenv("TDR_SEAL_CMA", "1")
+    monkeypatch.setenv("TDR_RING_CHUNK", str(16 << 10))
+    monkeypatch.setenv("TDR_FAULT_PLAN", "send:chunk=0:nth=1:corrupt=3")
+    fault_plan_reset()
+    seal_counters_reset()
+    cfg, pages = _toy()
+    worlds = local_worlds(2, free_port())
+    try:
+        sts = [WeightStreamer(w, pages, depth=2) for w in worlds]
+        outs = [[] for _ in range(2)]
+
+        def run(r):
+            st = sts[r]
+            for idx in list(range(len(pages))) * 2:
+                st.prefetch(idx)
+                view = st.acquire(idx)
+                outs[r].append(view.copy())
+                st.release(view)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        c = seal_counters()
+        assert c["failed"] >= 1 and c["retransmitted"] >= 1, c
+        for r in range(2):
+            for got, idx in zip(outs[r], list(range(len(pages))) * 2):
+                np.testing.assert_array_equal(got, pages.pages[idx])
+            s = sts[r].stats()
+            assert s["acquired"] == s["released"], s
+            assert s["high_water"] <= 2, s
+            sts[r].close()
+    finally:
+        monkeypatch.delenv("TDR_FAULT_PLAN")
+        fault_plan_reset()
+        for w in worlds:
+            w.close()
+    seal_counters_reset()
+
+
+def test_world2_kv_page_corrupt_rider_heals(monkeypatch):
+    """A corrupt rider on a streamed KV page walks the NAK/retransmit
+    ladder and every rank still receives the home rank's exact bytes,
+    under the request-tagged collective id."""
+    monkeypatch.setenv("TDR_SEAL_CMA", "1")
+    monkeypatch.setenv("TDR_RING_CHUNK", str(16 << 10))
+    monkeypatch.setenv("TDR_FAULT_PLAN", "send:chunk=0:nth=1:corrupt=3")
+    fault_plan_reset()
+    seal_counters_reset()
+    rng = np.random.default_rng(3)
+    payload = rng.standard_normal(6144).astype(np.float32)
+    worlds = local_worlds(2, free_port())
+    try:
+        kvs = [KVStream(w, max_elems=payload.size) for w in worlds]
+        got = [None, None]
+
+        def run(r):
+            got[r] = kvs[r].broadcast(payload if r == 0 else None,
+                                      home=0, request_id=9, seq=1,
+                                      n=payload.size)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        c = seal_counters()
+        assert c["failed"] >= 1 and c["retransmitted"] >= 1, c
+        np.testing.assert_array_equal(got[0], payload)
+        np.testing.assert_array_equal(got[1], payload)
+        for kv in kvs:
+            s = kv.engine.stats()
+            assert s["acquired"] == s["released"], s
+            kv.close()
+    finally:
+        monkeypatch.delenv("TDR_FAULT_PLAN")
+        fault_plan_reset()
+        for w in worlds:
+            w.close()
+    seal_counters_reset()
+
+
+# --------------------------------------------------- continuous batching
+
+
+def _scenario(b):
+    """Join/evict churn: R1+R2 decode, R3 queues while full, R1 is
+    evicted mid-stream, the freed slot admits R3 mid-stream."""
+    b.submit(Request(1, [3, 7, 11], 8))
+    b.submit(Request(2, [9, 2], 6))
+    for _ in range(3):
+        b.step()
+    b.submit(Request(3, [5, 1], 4))
+    b.evict(1)
+    b.run()
+    return {rid: r.tokens for rid, r in sorted(b.finished.items())}
+
+
+def test_batcher_join_evict_loopback_prefetch_parity():
+    """Loopback: the scenario evicts R1 mid-stream, admits R3
+    mid-stream, and prefetch on/off produce bitwise the same tokens
+    (the page bytes are identical; only the timing moves)."""
+    cfg, pages = _toy()
+    outs = {}
+    for prefetch in (False, True):
+        b = ContinuousBatcher(None, pages, cfg, max_slots=2,
+                              prefetch=prefetch)
+        outs[prefetch] = _scenario(b)
+        b.close()
+        assert b.finished[1].evicted
+        assert 0 < len(b.finished[1].tokens) < 8
+        assert not b.finished[2].evicted
+        assert len(b.finished[2].tokens) == 6
+        assert b.finished[3].joined_step > 0
+        assert len(b.finished[3].tokens) == 4
+        s = b.streamer.stats()
+        assert s["acquired"] == s["released"], s
+    assert outs[False] == outs[True]
+
+
+def test_batcher_world2_lockstep_bitwise_vs_loopback():
+    """World-2 streamed decode (weights gathered per page, KV joins
+    broadcast over the sealed path) produces tokens bitwise identical
+    on both ranks AND to the sequential loopback baseline."""
+    cfg, pages = _toy()
+    base = ContinuousBatcher(None, pages, cfg, max_slots=2,
+                             prefetch=False)
+    want = _scenario(base)
+    base.close()
+
+    worlds = local_worlds(2, free_port())
+    try:
+        bs = [ContinuousBatcher(w, pages, cfg, max_slots=2) for w in worlds]
+        got = [None, None]
+        errs = [None, None]
+
+        def run(r):
+            try:
+                got[r] = _scenario(bs[r])
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs[r] = e
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for e in errs:
+            if e is not None:
+                raise e
+        assert got[0] == got[1] == want
+        for b in bs:
+            b.close()
+        assert [w.pending_async for w in worlds] == [0, 0]
+    finally:
+        for w in worlds:
+            w.close()
+
+
+def test_batcher_requeued_eviction_before_admission():
+    """Evicting a request that is still QUEUED finishes it with zero
+    tokens at the next boundary instead of admitting it."""
+    cfg, pages = _toy()
+    b = ContinuousBatcher(None, pages, cfg, max_slots=1)
+    b.submit(Request(1, [4], 3))
+    b.submit(Request(2, [5], 3))
+    b.evict(2)
+    b.run()
+    b.close()
+    assert b.finished[2].evicted and b.finished[2].tokens == []
+    assert len(b.finished[1].tokens) == 3
+
+
+# ------------------------------------------------------------ the model
+
+
+def test_paged_decoder_matches_flax_llama():
+    """The numpy paged port greedy-decodes EXACTLY llama.generate's
+    tokens on llama-tiny (f32 end to end, same masking/RoPE/GQA)."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from rocnrdma_tpu.models import llama
+    from rocnrdma_tpu.serving.model import pack_llama_params
+
+    lcfg = llama.LLAMA_TINY
+    model = llama.make_model(lcfg)
+    params = llama.init_params(model, jax.random.PRNGKey(0))
+    cfg = ServeConfig.from_llama(lcfg)
+    pages = pack_llama_params(
+        cfg, jax.tree_util.tree_map(np.asarray, params))
+    assert page_names(cfg)[0] == "embed"
+    assert len(pages) == cfg.n_layers + 2
+
+    prompt = [5, 9, 42, 7]
+    want = np.asarray(llama.generate(
+        model, params, jnp.array([prompt], dtype=jnp.int32), 8,
+        temperature=0.0))[0].tolist()
+    b = ContinuousBatcher(None, pages, cfg, max_slots=1, prefetch=False)
+    b.submit(Request(1, prompt, 8))
+    b.run()
+    b.close()
+    assert b.finished[1].tokens == want
+
+
+def test_page_layout_roundtrip():
+    """pack_pages → unpack views reproduce the parameter tree
+    bitwise, and the page count/naming is the serving contract."""
+    from rocnrdma_tpu.serving.model import (unpack_embed, unpack_head,
+                                            unpack_layer)
+
+    cfg, pages = _toy(seed=13)
+    tree = toy_param_tree(cfg, seed=13)
+    np.testing.assert_array_equal(unpack_embed(cfg, pages.pages[0]),
+                                  tree["embed"])
+    for li in range(cfg.n_layers):
+        lay = unpack_layer(cfg, pages.pages[1 + li])
+        for k, v in tree[f"layer_{li}"].items():
+            np.testing.assert_array_equal(lay[k], v)
+    final_norm, lm_head = unpack_head(cfg, pages.pages[-1])
+    np.testing.assert_array_equal(final_norm, tree["final_norm"])
+    np.testing.assert_array_equal(lm_head, tree["lm_head"])
+    assert page_names(cfg) == ["embed", "layer_0", "layer_1", "head"]
+
+
+# ------------------------------------------------------------ SLO metrics
+
+
+def test_serve_counters_and_hist_ride_heartbeat_to_metrics():
+    """The serving SLO series render on /metrics under the
+    contract-pinned names: tdr_serve_requests_total{world=},
+    tdr_serve_tokens_total, and tdr_token_lat_us{quantile=} computed
+    from the FINE (log2×8) histogram rows the heartbeat pushes —
+    through the real coordinator wire (join → heartbeat → scrape),
+    with the payload shaped exactly as the world's heartbeat hooks
+    ship it (serve.* counters + fine rows carrying the {64:0}
+    marker)."""
+    from rocnrdma_tpu.control.client import ControlClient
+    from rocnrdma_tpu.control.coordinator import Coordinator
+
+    cfg, pages = _toy()
+    b = ContinuousBatcher(None, pages, cfg, max_slots=2)
+    b.submit(Request(1, [3, 7], 5))
+    b.submit(Request(2, [4], 5))
+    b.run()
+    b.close()
+    toks = trace.counter("serve.tokens")
+    assert toks == len(b.finished[1].tokens) + len(b.finished[2].tokens)
+
+    co = Coordinator(port=0, lease_ms=5000, port_base=free_port()).start()
+    try:
+        client = ControlClient(co.address)
+        views = [None, None]
+
+        def j(r):
+            views[r] = client.join("serve", 2, rank=r)
+
+        ts = [threading.Thread(target=j, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        hists = {name: {**{64: 0}, **row}
+                 for name, row in trace.hists().items()}
+        client.heartbeat("serve", 0, views[0]["incarnation"],
+                         views[0]["generation"],
+                         counters=trace.counters_prefixed("serve."),
+                         hists=hists)
+        body = client.metrics()
+    finally:
+        co.stop()
+    assert 'tdr_serve_requests_total{world="serve"} 2' in body
+    assert f'tdr_serve_tokens_total{{world="serve"}} {toks}' in body
+    # Quantiles come from FINE bucket edges — real numbers for the
+    # pinned quantile labels, not octave saturation.
+    for q in ("0.50", "0.90", "0.99"):
+        line = [ln for ln in body.splitlines()
+                if ln.startswith(f'tdr_token_lat_us{{world="serve",'
+                                 f'quantile="{q}"}}')]
+        assert line, f"quantile {q} not served:\n{body}"
+        assert float(line[0].rsplit(" ", 1)[1]) > 0
+    assert f'tdr_token_lat_us_count{{world="serve"}} {toks}' in body
+
+
+def test_fine_hist_rows_read_fine_edges_not_octave_edges():
+    """trace.hist buckets mirror the native fine layout, and a row
+    reconstructed the coordinator's way (grow-to-fit + the {64:0}
+    marker) yields sub-octave percentile estimates — the BENCH_r06
+    saturated-percentile defect, pinned for serving latencies."""
+    from rocnrdma_tpu.telemetry.recorder import (bucket_upper,
+                                                 fine_bucket_upper,
+                                                 hist_percentile)
+
+    trace.reset()
+    # 1100 lives in octave 11 (1024..2047), first sub-bucket:
+    # fine upper edge 1151, octave upper edge 2047.
+    for _ in range(4):
+        trace.hist("token_lat_us", 1100)
+    row = trace.hists()["token_lat_us"]
+    (bkt,) = row.keys()
+    assert row[bkt] == 4
+    assert fine_bucket_upper(bkt) == 1151
+    grown = [0] * 64
+    # Marker FIRST (the worker merges with setdefault): bucket 64 may
+    # legitimately hold counts — 1100's fine bucket IS 64.
+    for b, c in {**{64: 0}, **row}.items():
+        if b >= len(grown):
+            grown.extend([0] * (b + 1 - len(grown)))
+        grown[b] += c
+    assert hist_percentile(grown, 50) == 1151
+    assert hist_percentile(grown, 50) != bucket_upper(11)  # 2047
+    # Small values index themselves exactly.
+    trace.hist("small_us", 7)
+    assert trace.hists()["small_us"] == {7: 1}
+
+
+# ------------------------------------------------------------ attribution
+
+
+def test_tdr_explain_attributes_stream_requests():
+    """Request-tagged stream collectives decompose per request id in
+    tdr_explain: the serving section counts transfers/bytes per
+    request, and request 0 (shared weight pages) stays separate."""
+    from rocnrdma_tpu.telemetry.recorder import TelEvent, events_to_wire
+    from tdr_explain import analyze_segments, render_text
+
+    MS = 1_000_000
+
+    def seg(rank, colls):
+        evs = []
+        for i, coll in enumerate(colls):
+            t = (10 * i + rank)
+            evs += [
+                TelEvent(ts_ns=t * MS, name="ring_begin", engine=rank + 1,
+                         id=i + 1, arg=4096, coll=coll),
+                TelEvent(ts_ns=(t + 1) * MS, name="wire_tx",
+                         engine=rank + 1, qp=1, id=i + 1, arg=4096,
+                         coll=coll),
+                TelEvent(ts_ns=(t + 5) * MS, name="ring_end",
+                         engine=rank + 1, id=i + 1, arg=0, coll=coll),
+            ]
+        return {"events": events_to_wire(evs), "clock_offset_ns": 0,
+                "dropped": 0}
+
+    colls = [make_stream_coll(0, 1), make_stream_coll(7, 1),
+             make_stream_coll(7, 2), 5]
+    a = analyze_segments({"0": seg(0, colls), "1": seg(1, colls)})
+    serving = a["serving"]
+    assert serving["7"]["transfers"] == 2
+    assert serving["7"]["tx_bytes"] == 2 * 2 * 4096  # both ranks tx'd
+    assert serving["0"]["transfers"] == 1
+    assert "5" not in serving  # plain collective, not a stream
+    for c in a["collectives"]:
+        if is_stream_coll(c["coll"]):
+            assert c["request"] == stream_coll_request(c["coll"])
+            assert c["stream_seq"] == stream_coll_seq(c["coll"])
+        else:
+            assert "request" not in c
+    text = render_text(a)
+    assert "serving streams" in text
+    assert "req 7" in text

@@ -1,0 +1,368 @@
+#!/usr/bin/env python
+"""serve_smoke — the serving data path, end to end.
+
+CI hook for `make serve-smoke` / `serve-smoke-san`: a world-2
+continuous-batching decode over streamed weight pages, flight recorder
+on, asserting:
+
+  - **bitwise tokens**: the streamed, prefetched, continuously-batched
+    world-2 run produces exactly the sequential loopback baseline's
+    tokens — including a request that JOINS mid-stream (prefill on its
+    home rank, KV pages streamed to the peer) and one EVICTED
+    mid-stream at a token boundary;
+  - **heal**: a deterministic corrupt-rider on a streamed page fails
+    seal verification, NAKs, retransmits clean (seal counters move),
+    and the tokens are still bitwise right — the NAK/retransmit ladder
+    is intact under the serving path;
+  - **prefetch overlap**: wire events (page fetches) land inside the
+    ``serve.compute`` spans — layer k+1 streams under layer k's
+    matmuls — with the fraction gated (best-of-window, the repo's
+    1-core convention);
+  - **p99 token latency** under the gate, and **zero leaked
+    threads/credits/handles** across the loop + close (flat census).
+
+Also sweeps a small saturation curve (requests/s vs p99 token latency
+at rising concurrency) that bench.py records into BENCH_r10.json.
+
+The sanitized run (`serve-smoke-san`, TDR_SERVE_SMOKE_LITE=1) is
+numpy-only — jaxlib's MLIR pybind trips ASan's __cxa_throw interceptor
+(the control-smoke-san rationale) — toy params instead of llama-tiny's,
+same engine, pager, batcher, and native machinery end to end. Full
+mode packs the real flax llama-tiny ``init_params`` into pages and
+cross-checks the numpy port against ``llama.generate`` greedy tokens
+first.
+
+Prints one ``SERVE {json}`` line (bench.py parses it into the
+BENCH_r10 record). Respects the tier-1 rule: smokes never run
+concurrently with the tier-1 suite.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Big enough rings that the page-fetch lifecycle + spans survive
+# un-overwritten; must be set before the tracer module is imported.
+os.environ.setdefault("TDR_TELEMETRY_RING", str(1 << 20))
+os.environ.setdefault("TDR_TRACE_RING", "65536")
+os.environ.setdefault("TDR_PROGRESS_SHARDS", "2")
+os.environ.setdefault("TDR_RING_CHANNELS", "2")
+# Payload CRC on the CMA path: the corrupt-rider leg needs full seals
+# to detect the flipped bytes (tag-only seals wave them through).
+os.environ.setdefault("TDR_SEAL_CMA", "1")
+
+import numpy as np  # noqa: E402
+
+from rocnrdma_tpu import telemetry  # noqa: E402
+from rocnrdma_tpu.collectives.world import local_worlds  # noqa: E402
+from rocnrdma_tpu.serving.batcher import (  # noqa: E402
+    ContinuousBatcher, Request)
+from rocnrdma_tpu.serving.model import (  # noqa: E402
+    ServeConfig, pack_pages, toy_param_tree)
+from rocnrdma_tpu.transport.engine import (  # noqa: E402
+    fault_plan_reset, seal_counters, seal_counters_reset)
+from rocnrdma_tpu.utils.trace import trace  # noqa: E402
+
+LITE = os.environ.get("TDR_SERVE_SMOKE_LITE", "0") not in ("", "0")
+QUICK = os.environ.get("TDR_SERVE_QUICK", "0") not in ("", "0")
+
+
+def free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def task_count() -> int:
+    """Native thread census (the test_multichannel leak detector)."""
+    return len(os.listdir("/proc/self/task"))
+
+
+def settle_census(baseline: int, deadline_s: float = 5.0) -> int:
+    deadline = time.time() + deadline_s
+    while task_count() > baseline and time.time() < deadline:
+        time.sleep(0.05)
+    return task_count()
+
+
+def build_pages():
+    """(cfg, pages): llama-tiny's real flax params in full mode (with
+    a numpy-vs-jax greedy-token cross-check), toy params in LITE."""
+    if LITE:
+        cfg = ServeConfig(vocab_size=96, d_model=48, n_layers=2,
+                          n_heads=4, n_kv_heads=2, d_ff=96,
+                          max_seq_len=64, rope_theta=10000.0)
+        return cfg, pack_pages(cfg, toy_param_tree(cfg))
+    import jax
+
+    from rocnrdma_tpu.models import llama
+    from rocnrdma_tpu.serving.model import pack_llama_params
+
+    lcfg = llama.LLAMA_TINY
+    model = llama.make_model(lcfg)
+    params = llama.init_params(model, jax.random.PRNGKey(0))
+    cfg = ServeConfig.from_llama(lcfg)
+    np_params = jax.tree_util.tree_map(np.asarray, params)
+    pages = pack_llama_params(cfg, np_params)
+
+    # Cross-check: the numpy paged port greedy-decodes the SAME
+    # tokens the flax model does (parity is the port's contract).
+    import jax.numpy as jnp
+    prompt = jnp.array([[5, 9, 42, 7]], dtype=jnp.int32)
+    want = np.asarray(llama.generate(model, params, prompt, 8,
+                                     temperature=0.0))[0].tolist()
+    b = ContinuousBatcher(None, pages, cfg, max_slots=1, prefetch=False)
+    b.submit(Request(1, [5, 9, 42, 7], 8))
+    b.run()
+    b.close()
+    got = b.finished[1].tokens
+    assert got == want, f"numpy port diverged from flax: {got} != {want}"
+    return cfg, pages
+
+
+# The join/evict scenario, identical on every driver: R1+R2 decode,
+# three boundaries in, R3 queues and R1 is evicted mid-stream — the
+# next boundary frees R1's slot and admits R3 (prefill + KV join).
+def drive_scenario(batcher):
+    batcher.submit(Request(1, [3, 7, 11], 8))
+    batcher.submit(Request(2, [9, 2], 10))
+    for _ in range(3):
+        batcher.step()
+    batcher.submit(Request(3, [5, 1], 6))
+    batcher.evict(1)
+    batcher.run()
+    return {rid: r.tokens for rid, r in sorted(batcher.finished.items())}
+
+
+def run_world2(pages, cfg, fn, max_slots=2, prefetch=True, depth=None):
+    """Run ``fn(batcher)`` lockstep on a world-2 pair; returns
+    (results, batchers, worlds) — caller asserts and closes."""
+    worlds = local_worlds(2, free_port())
+    batchers = [ContinuousBatcher(w, pages, cfg, max_slots=max_slots,
+                                  prefetch=prefetch, depth=depth)
+                for w in worlds]
+    results = [None, None]
+    errs = [None, None]
+
+    def drive(i):
+        try:
+            results[i] = fn(batchers[i])
+        except BaseException as e:  # noqa: BLE001
+            errs[i] = e
+
+    ts = [threading.Thread(target=drive, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for e in errs:
+        if e is not None:
+            for b in batchers:
+                try:
+                    b.close()
+                except BaseException:
+                    pass
+            for w in worlds:
+                w.close()
+            raise e
+    return results, batchers, worlds
+
+
+def close_all(batchers, worlds):
+    # Close batchers first: run-ahead prefetches legitimately hold
+    # live handles until the streamer drains them.
+    for b in batchers:
+        b.close()
+    pend = [w.pending_async for w in worlds]
+    for w in worlds:
+        w.close()
+    assert pend == [0, 0], f"leaked async handles: {pend}"
+    for b in batchers:
+        for eng in (b.streamer.engine, b.kv.engine):
+            s = eng.stats()
+            assert s["live"] == 0, f"{s['name']}: live transfers leak"
+            assert s["acquired"] == s["released"], \
+                f"{s['name']}: credit imbalance {s}"
+
+
+def main() -> int:
+    cfg, pages = build_pages()
+
+    # 1. Sequential loopback baseline: no transport, no prefetch.
+    base = ContinuousBatcher(None, pages, cfg, max_slots=2,
+                             prefetch=False)
+    want = drive_scenario(base)
+    base.close()
+    assert base.finished[1].evicted and len(want[1]) < 8, \
+        "scenario must evict R1 mid-stream"
+    assert base.finished[3].joined_step > base.finished[2].joined_step, \
+        "scenario must join R3 mid-stream"
+
+    telemetry.enable()
+
+    # 2. World-2 streamed run under a corrupt-rider fault plan: the
+    # rider NAKs, retransmits clean, and tokens stay bitwise the
+    # baseline's.
+    os.environ["TDR_FAULT_PLAN"] = "send:chunk=0:nth=1:corrupt=3"
+    fault_plan_reset()
+    seal_counters_reset()
+    try:
+        results, batchers, worlds = run_world2(pages, cfg,
+                                               drive_scenario)
+        heal = {k: int(v) for k, v in seal_counters().items()}
+        close_all(batchers, worlds)
+    finally:
+        os.environ.pop("TDR_FAULT_PLAN", None)
+        fault_plan_reset()
+    assert results[0] == results[1] == want, \
+        (f"streamed tokens diverged from sequential baseline:\n"
+         f"  r0={results[0]}\n  r1={results[1]}\n  want={want}")
+    assert heal.get("failed", 0) >= 1 and \
+        heal.get("retransmitted", 0) >= 1, \
+        f"corrupt rider did not walk the NAK/retransmit ladder: {heal}"
+    seal_counters_reset()
+
+    # 3. Saturation sweep: requests/s vs p99 token latency at rising
+    # concurrency; overlap fraction measured per level (wire events
+    # inside serve.compute spans), best-of-window reported.
+    levels = [1, 4] if QUICK else [1, 2, 4, 8]
+    gen = 4 if QUICK else 8
+    curve = []
+    windows = []
+    census_baseline = None
+    for conc in levels:
+        def load(b, conc=conc):
+            for i in range(conc):
+                b.submit(Request(10 + i, [2 + i, 5, 3], gen))
+            t0 = time.perf_counter()
+            b.run()
+            return {"dt": time.perf_counter() - t0,
+                    "tokens": sum(len(r.tokens)
+                                  for r in b.finished.values()),
+                    "lat": list(b.token_lat_us)}
+
+        telemetry.reset()
+        results, batchers, worlds = run_world2(
+            pages, cfg, load, max_slots=max(2, conc))
+        if census_baseline is None:
+            census_baseline = task_count()
+        frac = telemetry.overlap_fraction(telemetry.timeline(),
+                                          span="serve.compute")
+        steady = settle_census(census_baseline)
+        assert steady <= census_baseline, \
+            (f"threads grew {census_baseline} -> {steady} at "
+             f"concurrency {conc}")
+        close_all(batchers, worlds)
+        r0 = results[0]
+        lat = sorted(r0["lat"])
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else 0.0
+        curve.append({
+            "concurrency": conc,
+            "requests_s": round(conc / r0["dt"], 3),
+            "tokens_s": round(r0["tokens"] / r0["dt"], 3),
+            "p99_token_us": round(p99, 1),
+            "overlap_fraction": frac["overlap_fraction"],
+            "wire_events": frac["wire_events"],
+        })
+        windows.append(frac["overlap_fraction"])
+
+    # 4. Prefetch vs non-prefetch at top concurrency: the throughput
+    # the streaming engine must not lose to. Same convention as the
+    # overlap fraction above — single windows on a shared/1-core host
+    # are scheduler noise, so both sides get the SAME number of trials
+    # and the best window of each is compared.
+    conc = levels[-1]
+    # QUICK (CI/san) keeps one window per side — schema over precision;
+    # the official record's gate compares best-of-3 per side.
+    trials = 1 if QUICK else 3
+
+    def load_np(b):
+        for i in range(conc):
+            b.submit(Request(10 + i, [2 + i, 5, 3], gen))
+        t0 = time.perf_counter()
+        b.run()
+        return {"dt": time.perf_counter() - t0,
+                "tokens": sum(len(r.tokens) for r in b.finished.values())}
+
+    def tokens_s(prefetch):
+        results, batchers, worlds = run_world2(pages, cfg, load_np,
+                                               max_slots=max(2, conc),
+                                               prefetch=prefetch)
+        close_all(batchers, worlds)
+        return round(results[0]["tokens"] / results[0]["dt"], 3)
+
+    pre_windows = [curve[-1]["tokens_s"]]
+    pre_windows += [tokens_s(True) for _ in range(trials - 1)]
+    np_windows = [tokens_s(False) for _ in range(trials)]
+    noprefetch_tokens_s = max(np_windows)
+    telemetry.disable()
+
+    prefetch_tokens_s = max(pre_windows)
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    # Cores-aware gate (the BENCH_r08 convention): the 0.3 bar assumes
+    # compute can run WHILE the progress threads move frames — on a
+    # 1-core host every GEMV shares the core with the wire, so the
+    # fraction is scheduler-bound, not engine-bound; the bar drops to
+    # a sanity floor and the record carries host_cores for BENCH_r10's
+    # bound_note. TDR_SERVE_GATE overrides either way — the sanitized
+    # run sets it low (ASan multiplies the native wire's cost while
+    # numpy compute runs unsanitized; that run's job is the
+    # memory-error/UB sweep, not the timing claim).
+    default_gate = "0.3" if cores >= 2 else "0.05"
+    gate = float(os.environ.get("TDR_SERVE_GATE", default_gate))
+    out = {
+        "mode": "lite" if LITE else "full",
+        "world": 2,
+        "host_cores": cores,
+        "overlap_gate": gate,
+        "pages": len(pages),
+        "page_bytes_max": pages.max_elems * 4,
+        "depth": batchers[0].streamer.depth,
+        "curve": curve,
+        "windows": sorted(windows),
+        "overlap_fraction": max(windows),
+        "prefetch_tokens_s": prefetch_tokens_s,
+        "noprefetch_tokens_s": noprefetch_tokens_s,
+        "tokens_s_windows": {"prefetch": sorted(pre_windows),
+                             "noprefetch": sorted(np_windows)},
+        "heal": {"failed": heal.get("failed", 0),
+                 "retransmitted": heal.get("retransmitted", 0)},
+        "scenario": {"evicted": 1, "joined_midstream": 1,
+                     "bitwise_ok": True,
+                     "tokens": {str(k): v for k, v in want.items()}},
+        "serve_requests": trace.counter("serve.requests"),
+        "serve_tokens": trace.counter("serve.tokens"),
+    }
+    print("SERVE " + json.dumps(out))
+
+    p99_gate = float(os.environ.get("TDR_SERVE_P99_US", "500000"))
+    worst_p99 = max(c["p99_token_us"] for c in curve)
+    assert all(c["wire_events"] > 0 for c in curve), \
+        "no wire events recorded — pages did not ride the wire"
+    assert out["overlap_fraction"] > gate, \
+        (f"serve overlap_fraction {out['overlap_fraction']} <= {gate}:"
+         " page fetches are not hiding behind compute")
+    assert worst_p99 < p99_gate, \
+        f"p99 token latency {worst_p99}us >= {p99_gate}us"
+    print(f"serve-smoke OK: mode={out['mode']} "
+          f"overlap_fraction={out['overlap_fraction']} "
+          f"tokens_s={prefetch_tokens_s} "
+          f"(noprefetch {noprefetch_tokens_s}) p99us={worst_p99}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,9 @@ if REPO not in sys.path:
 from rocnrdma_tpu.telemetry.recorder import (TelEvent,  # noqa: E402
                                              events_from_wire)
 from rocnrdma_tpu.telemetry.perfetto import _tier_of_world  # noqa: E402
+from rocnrdma_tpu.serving.stream import (  # noqa: E402
+    is_stream_coll as _is_stream_coll,
+    stream_coll_request as _stream_coll_request)
 
 _PHASE_OF = {
     "post_send": "post", "post_recv": "post", "post_write": "post",
@@ -208,13 +211,21 @@ def analyze_segments(segments: Dict[Any, Dict[str, Any]],
                           for d in ranks_out.values())
                    for p in PHASES}
             slowest_phase = max(agg, key=agg.get)
-        colls.append({
+        centry = {
             "coll": coll,
             "auto_id": bool(coll >> 63),
             "ranks": ranks_out,
             "straggler": straggler,
             "slowest_phase": slowest_phase,
-        })
+        }
+        # Serving streams stamp structured ids (bit 62 | request<<40 |
+        # seq — serving/stream.py) through the same FEAT_COLL_ID
+        # bytes, so a decode stream's transfers decompose per request
+        # exactly like collectives decompose per rank.
+        if _is_stream_coll(coll):
+            centry["request"] = _stream_coll_request(coll)
+            centry["stream_seq"] = coll & ((1 << 40) - 1)
+        colls.append(centry)
 
     # ---- per-link bandwidth: tx (src right lane c) -> rx (dst left
     # lane c), matched by frame seq within the lane pair ----
@@ -274,6 +285,32 @@ def analyze_segments(segments: Dict[Any, Dict[str, Any]],
             "MBps": round(nbytes / dt / 1e6, 3),
         })
 
+    # ---- per-request serving attribution: aggregate the stream-
+    # tagged collectives by request id (0 = batch-level weight
+    # traffic shared by every rider). The straggler vote is recounted
+    # within the request's own transfers — "which rank delays THIS
+    # decode stream" is the serving question, and it can differ from
+    # the fleet-wide vote when one request's KV home sits on a slow
+    # link.
+    serving: Dict[str, Dict[str, Any]] = {}
+    for c in colls:
+        if "request" not in c:
+            continue
+        rid = str(c["request"])
+        agg_r = serving.setdefault(rid, {
+            "transfers": 0, "wall_s": 0.0, "tx_bytes": 0, "retx": 0,
+            "straggler_votes": {},
+        })
+        agg_r["transfers"] += 1
+        for d in c["ranks"].values():
+            agg_r["wall_s"] = round(agg_r["wall_s"] + d["wall_s"], 6)
+            agg_r["tx_bytes"] += d["tx_bytes"]
+            agg_r["retx"] += d["retx"]
+        if c["straggler"] is not None and len(c["ranks"]) > 1:
+            sv = agg_r["straggler_votes"]
+            key = str(c["straggler"])
+            sv[key] = sv.get(key, 0) + 1
+
     straggler_rank = (max(straggler_votes, key=straggler_votes.get)
                       if straggler_votes else None)
     result = {
@@ -289,6 +326,7 @@ def analyze_segments(segments: Dict[Any, Dict[str, Any]],
                                for r, v in sorted(wall_sums.items())},
         },
         "links": links,
+        "serving": serving,
         "degraded_links": {str(r): lm
                            for r, lm in sorted(degraded.items()) if lm},
         "tainted_ranks": {str(r): n for r, n in sorted(tainted.items())},
@@ -419,6 +457,20 @@ def render_text(a: Dict[str, Any]) -> str:
             retx = f" retx={d['retx']}" if d["retx"] else ""
             lines.append(f"    r{r}: wall={d['wall_s'] * 1e3:.2f}ms "
                          f"{_fmt_phases(d['phases_s'])}{retx}")
+    if a.get("serving"):
+        lines.append("serving streams (per request; 0 = shared "
+                     "weight pages):")
+        for rid, d in sorted(a["serving"].items(),
+                             key=lambda kv: int(kv[0])):
+            sv = d["straggler_votes"]
+            worst = max(sv, key=sv.get) if sv else None
+            tail = (f" straggler=r{worst} ({sv[worst]} votes)"
+                    if worst is not None else "")
+            retx = f" retx={d['retx']}" if d["retx"] else ""
+            lines.append(
+                f"  req {rid}: {d['transfers']} transfers "
+                f"{d['tx_bytes']} B wall={d['wall_s'] * 1e3:.1f}ms"
+                f"{retx}{tail}")
     if a["links"]:
         lines.append("links (tx->rx matched by lane+seq):")
         for ln in a["links"]:
