@@ -6,7 +6,7 @@ registry keeps one EWMA score per (world, link), normalized against the
 best goodput that link has ever sustained, so "healthy" is defined by
 the link's own history — no absolute MB/s threshold to mis-tune.
 
-The score of DELEGATE (inter-host) links drives a two-rung ladder,
+The score of DELEGATE (inter-host) links drives a three-rung ladder,
 mildest rung first (intra links are scored and reported but never
 steer the schedule — see ``_gates_schedule``):
 
@@ -16,6 +16,12 @@ steer the schedule — see ``_gates_schedule``):
          (mantissa truncation) before the inter-host phase — the
          precision contract changes, digest-stamped so every rank
          agrees or fails fast.
+  score < TDR_HEALTH_WIRE_INT8 (default 0.6)
+      -> deeper wire downgrade: the delegate payload rides the int8
+         scale-carrying q8 schedule (half the bf16 bytes). Engages
+         only when the transport negotiated FEAT_WIRE_Q8
+         (TDR_NO_WIRE_Q8 unset); digest-stamped ``hwire=int8``,
+         shadowing the bf16 term.
   score < TDR_HEALTH_FALLBACK (default 0.5)
       -> hierarchical -> flat algorithm fallback: the schedule stops
          riding the sick delegate link entirely (``choose_algo``
@@ -60,8 +66,8 @@ from rocnrdma_tpu.utils.trace import trace
 
 __all__ = [
     "observe", "fault", "score", "fallback_active", "wire_downgrade",
-    "degraded_links", "snapshot", "degraded_total", "reset",
-    "ladder_enabled", "schedule_verdict",
+    "wire_int8", "degraded_links", "snapshot", "degraded_total",
+    "reset", "ladder_enabled", "schedule_verdict", "wire_verdict",
 ]
 
 
@@ -96,7 +102,7 @@ def ladder_enabled() -> bool:
 
 class _Link:
     __slots__ = ("peer", "ewma", "peak", "samples", "faults",
-                 "wire_down", "fallback", "streak")
+                 "wire_down", "wire_int8", "fallback", "streak")
 
     def __init__(self, peer: int):
         self.peer = peer
@@ -106,13 +112,15 @@ class _Link:
         self.faults = 0
         # Engaged rungs (hysteresis state — see _requalify).
         self.wire_down = False
+        self.wire_int8 = False
         self.fallback = False
         # Consecutive below-threshold evaluations per rung
-        # [wire, fallback]: soft (goodput) evidence must persist for
-        # TDR_HEALTH_ENGAGE_STREAK samples before a rung engages — a
-        # single slow phase is scheduler noise, three in a row is a
-        # link. fault() evidence is hard and bypasses the streak.
-        self.streak = [0, 0]
+        # [wire, fallback, wire_int8]: soft (goodput) evidence must
+        # persist for TDR_HEALTH_ENGAGE_STREAK samples before a rung
+        # engages — a single slow phase is scheduler noise, three in a
+        # row is a link. fault() evidence is hard and bypasses the
+        # streak.
+        self.streak = [0, 0, 0]
 
 
 class _Registry:
@@ -124,6 +132,9 @@ class _Registry:
         # (world -> {coll seq -> 'hier'|'flat'|'canary'}) — frozen
         # per-collective schedule verdicts (see schedule_verdict).
         self._verdicts: Dict[str, Dict[int, str]] = {}
+        # (world -> {coll seq -> 'f32'|'bf16'|'int8'}) — frozen
+        # per-collective wire verdicts (see wire_verdict).
+        self._wire_verdicts: Dict[str, Dict[int, str]] = {}
 
     # ------------------------------------------------------------ feed
 
@@ -202,6 +213,19 @@ class _Registry:
             return any(ln.wire_down
                        for ln in self._worlds.get(world, {}).values())
 
+    def wire_int8(self, world: str) -> bool:
+        """Any link on the int8 rung (the one below bf16). Gated on
+        the q8 schedule being NEGOTIABLE (TDR_NO_WIRE_Q8 unset) here —
+        not just at engagement time — so the digest stamp and the
+        schedule the world actually runs can never disagree."""
+        if not ladder_enabled():
+            return False
+        if os.environ.get("TDR_NO_WIRE_Q8", "0") not in ("", "0"):
+            return False
+        with self._mu:
+            return any(ln.wire_int8
+                       for ln in self._worlds.get(world, {}).values())
+
     def degraded_links(self, world: str) -> Dict[str, int]:
         """{link_name: peer_rank} for links with ANY engaged rung —
         what quarantine reporting and ``tdr_explain`` attribute
@@ -209,7 +233,7 @@ class _Registry:
         with self._mu:
             return {name: ln.peer
                     for name, ln in self._worlds.get(world, {}).items()
-                    if ln.fallback or ln.wire_down}
+                    if ln.fallback or ln.wire_down or ln.wire_int8}
 
     def snapshot(self, world: str) -> Dict[str, Dict[str, float]]:
         """Heartbeat payload: per-link score/peer/rung state, served
@@ -219,7 +243,8 @@ class _Registry:
             for name, ln in self._worlds.get(world, {}).items():
                 s = 1.0 if ln.peak <= 0.0 else min(1.0, ln.ewma / ln.peak)
                 out[name] = {"peer": ln.peer, "score": round(s, 4),
-                             "degraded": int(ln.fallback or ln.wire_down),
+                             "degraded": int(ln.fallback or ln.wire_down
+                                             or ln.wire_int8),
                              "faults": ln.faults}
         return out
 
@@ -269,16 +294,53 @@ class _Registry:
                         del dec[k]
             return v
 
+    def wire_verdict(self, world: str, seq: int) -> str:
+        """'f32' | 'bf16' | 'int8' — ONE frozen wire verdict per
+        (world, collective sequence number), the wire-rung twin of
+        ``schedule_verdict``. The bf16 rung only truncates mantissas
+        in place (same ring schedule, same byte counts), so ranks
+        transiently split across f32/bf16 still interoperate; the int8
+        rung swaps the WIRE SCHEDULE itself (the scale-carrying q8
+        piece format), so rank A reading the rung live as engaged
+        while rank B reads it disengaged for the SAME collective runs
+        mismatched schedules into a deadlock. The first rank to ask
+        locks the answer for that seq; everyone else replays it
+        (multi-process ranks each freeze their own registry's verdict;
+        the digest's health stamp catches disagreement there)."""
+        if not ladder_enabled():
+            return "f32"
+        seq = int(seq)
+        with self._mu:
+            dec = self._wire_verdicts.setdefault(world, {})
+            v = dec.get(seq)
+            if v is None:
+                links = self._worlds.get(world, {}).values()
+                q8_ok = os.environ.get("TDR_NO_WIRE_Q8",
+                                       "0") in ("", "0")
+                if q8_ok and any(ln.wire_int8 for ln in links):
+                    v = "int8"
+                elif any(ln.wire_down for ln in links):
+                    v = "bf16"
+                else:
+                    v = "f32"
+                dec[seq] = v
+                if len(dec) > 256:  # bound the memory; old seqs are dead
+                    for k in sorted(dec)[:128]:
+                        del dec[k]
+            return v
+
     def reset(self, world: Optional[str] = None) -> None:
         with self._mu:
             if world is None:
                 self._worlds.clear()
                 self._degraded_total.clear()
                 self._verdicts.clear()
+                self._wire_verdicts.clear()
             else:
                 self._worlds.pop(world, None)
                 self._degraded_total.pop(world, None)
                 self._verdicts.pop(world, None)
+                self._wire_verdicts.pop(world, None)
 
     # ------------------------------------------------------- internals
 
@@ -308,10 +370,12 @@ class _Registry:
             return
         s = 1.0 if ln.peak <= 0.0 else ln.ewma / ln.peak
         wire_thr = _env_float("TDR_HEALTH_WIRE", 0.75, 0.0, 1.0)
+        int8_thr = _env_float("TDR_HEALTH_WIRE_INT8", 0.6, 0.0, 1.0)
         fb_thr = _env_float("TDR_HEALTH_FALLBACK", 0.5, 0.0, 1.0)
         heal = _env_float("TDR_HEALTH_HEAL", 0.1, 0.0, 0.5)
         need = int(_env_float("TDR_HEALTH_ENGAGE_STREAK", 3, 1, 64))
-        rungs = (("wire_down", wire_thr, 0), ("fallback", fb_thr, 1))
+        rungs = (("wire_down", wire_thr, 0), ("wire_int8", int8_thr, 2),
+                 ("fallback", fb_thr, 1))
         for attr, thr, si in rungs:
             engaged = getattr(ln, attr)
             if not engaged and s < thr:
@@ -341,8 +405,10 @@ fault = _REG.fault
 score = _REG.score
 fallback_active = _REG.fallback_active
 wire_downgrade = _REG.wire_downgrade
+wire_int8 = _REG.wire_int8
 degraded_links = _REG.degraded_links
 snapshot = _REG.snapshot
 degraded_total = _REG.degraded_total
 schedule_verdict = _REG.schedule_verdict
+wire_verdict = _REG.wire_verdict
 reset = _REG.reset

@@ -53,8 +53,10 @@ VA order would desynchronize the ring), then the staged groups.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import os
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
@@ -77,6 +79,10 @@ from rocnrdma_tpu.utils.trace import trace
 # addresses churn (shape changes, allocator growth) — eviction then
 # drops the least-recently-registered unused entries.
 _REG_CACHE_MAX = 128
+
+# Minimal stand-in leaf for digest construction from an abstract plan
+# (``_sched_describe`` only reads ``.size`` for staged-group terms).
+_SizeLeaf = collections.namedtuple("_SizeLeaf", "size")
 
 # Adjacent device leaves (same dtype, same allocation) are coalesced
 # into one ring op across alignment gaps up to this many bytes — a
@@ -120,10 +126,22 @@ class CrossSliceAllReduce:
                  mean: bool = False,
                  overlap: bool = False,
                  bucket_bytes: Optional[int] = None,
-                 wire_dtype: Optional[str] = None):
+                 wire_dtype: Optional[str] = None,
+                 per_layer: bool = False):
         self.world = world
         self.exporter = exporter
         self.mean = mean
+        # Per-layer backward overlap: the trainer taps each layer's
+        # parameter subtree with an identity custom_vjp whose backward
+        # rule delivers that LAYER's concrete gradients to
+        # ``start_layered()``'s pending object the moment XLA's
+        # backward pass produces them — bucket k's allreduce launches
+        # while layer k-1's grads are still being computed (true
+        # compute overlap, not just staging overlap). Implies
+        # ``overlap`` (the wire machinery is the bucketed path's).
+        self.per_layer = bool(per_layer)
+        if self.per_layer:
+            overlap = True
         # Backward-overlap mode: ``start(tree)`` launches each
         # gradient BUCKET's allreduce nonblocking the moment its
         # leaves' D2H copies land, and ``finish()`` waits the handles
@@ -138,26 +156,33 @@ class CrossSliceAllReduce:
         # with divergent bucket configs fail the first collective fast.
         self.bucket_bytes = None if bucket_bytes is None else \
             int(bucket_bytes)
-        # Optional on-wire gradient compression (TDR_WIRE_DTYPE=bf16):
-        # f32 staged buckets are rounded to bf16 (with per-rank error
-        # feedback: this step's rounding error is added back into the
-        # next step's gradients, bounding drift) and the ring reduces
-        # the bf16 buffer — half the wire bytes. Negotiated like
-        # FEAT_SEAL at the collective layer: the wire dtype is
-        # schedule-changing, so it is digest-carried (``wire=bf16``)
-        # and mismatched ranks fail fast instead of mis-folding each
+        # Optional on-wire gradient compression (TDR_WIRE_DTYPE=bf16
+        # or =int8): f32 staged buckets are compressed on the wire
+        # with per-rank error feedback (this step's rounding error is
+        # added back into the next step's gradients, bounding drift).
+        # bf16 rounds to half the wire bytes and the ring folds bf16
+        # natively; int8 quantizes symmetrically against the bucket's
+        # absmax (scale = absmax/127, computed AT STAGING, after the
+        # residual joins) and rides the native running-scale
+        # dequant-fold schedule (tdr_ring_allreduce_q8) — ~quarter the
+        # f32 bytes, with each wire piece carrying its 4-byte f32
+        # scale alongside the int8 payload inside ordinary sealed SEND
+        # frames. The wire dtype is schedule-changing, so it is
+        # digest-carried (``wire=bf16`` / ``wire=int8``) and
+        # mismatched ranks fail fast instead of mis-folding each
         # other's frames; compressed frames are ordinary sealed
         # payloads, so the CRC/NAK/retransmit ladder covers them
-        # unchanged.
+        # unchanged, and the int8 SCHEDULE itself is FEAT-negotiated
+        # (FEAT_WIRE_Q8, off ⇒ legacy frames byte-identical).
         wire = wire_dtype if wire_dtype is not None else \
             os.environ.get("TDR_WIRE_DTYPE", "")
         if wire in ("", "f32", "float32", None):
             wire = None
-        elif wire != "bf16":
-            raise ValueError(f"TDR_WIRE_DTYPE={wire!r}: only 'bf16' "
-                             "(or unset) is supported")
+        elif wire not in ("bf16", "int8"):
+            raise ValueError(f"TDR_WIRE_DTYPE={wire!r}: only 'bf16' or "
+                             "'int8' (or unset) is supported")
         if wire and not self.overlap:
-            raise ValueError("wire_dtype=bf16 requires overlap=True "
+            raise ValueError(f"wire_dtype={wire} requires overlap=True "
                              "(compression rides the bucketed path)")
         self.wire_dtype = wire
         # Persistent per-dtype staging buffers, registered with the
@@ -648,11 +673,15 @@ class CrossSliceAllReduce:
         digest is byte-identical there; handles execute in submission
         order natively, so results are bitwise the fused path's.
 
-        With ``TDR_WIRE_DTYPE=bf16``, float32 staged buckets are
-        rounded to bf16 on the wire with per-rank error feedback (the
-        rounding error joins the next step's gradients); the wire
-        dtype is digest-carried and the compressed frames are ordinary
-        sealed payloads (CRC/NAK/retransmit unchanged).
+        With ``TDR_WIRE_DTYPE=bf16`` (or ``int8``), float32 staged
+        buckets are compressed on the wire with per-rank error
+        feedback (the rounding error joins the next step's gradients).
+        bf16 rounds in place and the ring folds bf16 natively; int8
+        quantizes each bucket against its absmax and rides the
+        FEAT_WIRE_Q8 running-scale schedule, whose [scale][payload]
+        pieces travel as ordinary sealed SEND frames. Either way the
+        wire dtype is digest-carried and the compressed frames are
+        ordinary sealed payloads (CRC/NAK/retransmit unchanged).
 
         A transport failure surfaces from ``start`` or ``finish`` as
         the same taxonomy-classified TransportError the blocking path
@@ -745,9 +774,14 @@ class CrossSliceAllReduce:
         sizes = [int(leaves[i].size) for i in idxs]
         total = int(sum(sizes))
         buf = self._stage(dtype_str, total)
-        compress = self.wire_dtype == "bf16" and dtype_str == "float32"
+        compress = self.wire_dtype is not None and dtype_str == "float32"
+        q8 = compress and self.wire_dtype == "int8"
         wbuf = self._stage_wire(dtype_str, total) if compress else None
         res = self._residual(dtype_str, total) if compress else None
+        # Per-bucket quantization scales (int8 only): computed by the
+        # bucket's produce callback, read by its launch lambda — the
+        # engine runs produce strictly before launch for a given tag.
+        scales: Dict[int, float] = {}
         staging.add(total * itemsize * 2)  # D2H + H2D round trip
         trace.event("xslice.staged_group", dtype=dtype_str,
                     bytes=total * itemsize, leaves=len(idxs),
@@ -767,10 +801,14 @@ class CrossSliceAllReduce:
         # registration takes the native ring lock, which would
         # otherwise serialize behind the async driver's running
         # collective and stall the very overlap this path exists for.
+        # The int8 schedule needs NO slice MRs: its [scale][payload]
+        # pieces stage through the ring's own scratch, so the caller
+        # buffers never touch the wire (and never race a dereg).
         reg_key = ("w:" if compress else "s:") + dtype_str
         target = wbuf if compress else buf
-        for o, n, _members in segs:
-            self._register_slice(reg_key, target[o:o + n])
+        if not q8:
+            for o, n, _members in segs:
+                self._register_slice(reg_key, target[o:o + n])
         def bucket_produce(o: int, n: int, members, k: int) -> None:
             # Bucket spans ride their own exporter lanes (lane=) so
             # the gather/wire interleaving reads as parallel bars in
@@ -788,10 +826,37 @@ class CrossSliceAllReduce:
                     # Error feedback: compress (grad + residual),
                     # carry the new rounding error to the next step.
                     seg += res[o:o + n]
-                    wbuf[o:o + n] = seg.astype(wbuf.dtype)  # RNE
-                    np.subtract(seg,
-                                wbuf[o:o + n].astype(np.float32),
-                                out=res[o:o + n])
+                    if q8:
+                        # Symmetric absmax quantization AT STAGING:
+                        # the scale is this rank's contribution to the
+                        # wire piece's running scale (the native fold
+                        # sums scales and renormalizes payloads).
+                        absmax = float(np.max(np.abs(seg))) if n else 0.0
+                        scale = absmax / 127.0
+                        scales[k] = scale
+                        if scale > 0.0:
+                            np.rint(seg / scale, casting="unsafe",
+                                    out=wbuf[o:o + n])
+                        else:
+                            wbuf[o:o + n] = 0
+                        np.subtract(
+                            seg,
+                            wbuf[o:o + n].astype(np.float32) * scale,
+                            out=res[o:o + n])
+                    else:
+                        wbuf[o:o + n] = seg.astype(wbuf.dtype)  # RNE
+                        np.subtract(seg,
+                                    wbuf[o:o + n].astype(np.float32),
+                                    out=res[o:o + n])
+
+        def launch(o: int, n: int, k: int):
+            if q8:
+                # The native q8 allreduce dequantizes straight into
+                # the f32 staging slice — the scatter then reads buf
+                # exactly as the uncompressed path does.
+                return self.world.allreduce_q8_async(
+                    wbuf[o:o + n], scales[k], buf[o:o + n])
+            return self.world.allreduce_async(target[o:o + n])
 
         for k, (o, n, members) in enumerate(segs):
             # produce (gather+compress) then launch, then yield one
@@ -803,14 +868,62 @@ class CrossSliceAllReduce:
             # silicon; the yield is the 1-core stand-in (sub-µs no-op
             # elsewhere).
             h = self._engine.submit(
-                lambda o=o, n=n: self.world.allreduce_async(
-                    target[o:o + n]),
+                lambda o=o, n=n, k=k: launch(o, n, k),
                 produce=lambda o=o, n=n, m=members, k=k:
                     bucket_produce(o, n, m, k),
                 yield_cpu=True, tag=("seg", k))
             launched.append(h)
             ops.append(("seg", h, (dtype_str, o, n, list(members),
                                    compress, k)))
+
+    # ---------------------------------------- per-layer backward path
+
+    def start_layered(self, plan: List[Tuple[str, List[Tuple[int, str]]]]
+                      ) -> "Any":
+        """Open a per-layer overlapped sync for one training step.
+
+        ``plan`` is the step's bucket plan in TREE order: one entry per
+        layer parameter subtree, ``(key, [(size, dtype_str), ...])``
+        with the leaves in tree order. It is a pure function of the
+        model config, so every rank derives the identical plan — and
+        the plan (with per-bucket keys and per-leaf sizes) is hashed
+        into the schedule digest before any wire work, so a rank whose
+        plan diverges fails the first collective fast.
+
+        Returns a pending object: the trainer's gradient taps call
+        ``push(idx, leaves)`` with bucket ``idx``'s concrete host
+        gradients AS the backward pass produces them (ordered
+        io_callback — the delivery order is the program's backward
+        order, identical on every rank, which is what keeps the async
+        submission order SPMD); ``finish(tree)`` waits the handles in
+        submission order, scatters the reduced values into fresh
+        leaves shaped like ``tree``, and returns the reduced tree.
+        Wire compression (bf16 / int8 + error feedback) applies per
+        f32 bucket segment exactly as on the bucketed path.
+
+        Verbs (pinning) engines degrade to the fused synchronous path
+        at ``finish()`` time, same as ``start()``."""
+        if self.world.engine.kind == ENGINE_VERBS:
+            return _LayeredDeferred(self)
+        return _LayeredSync(self, plan)
+
+    def _layered_describe(self, plan) -> str:
+        """Schedule describe string for the per-layer plan: the shared
+        base terms plus per-leaf sizes and an ``lplan=`` term naming
+        the bucket boundaries — a per-layer rank against a bucketed
+        (or differently-bucketed) rank fails the digest, never
+        desynchronizes the ring."""
+        fake = []
+        groups: Dict[str, List[int]] = {}
+        for _key, leaves in plan:
+            for size, dtype_str in leaves:
+                groups.setdefault(dtype_str, []).append(len(fake))
+                fake.append(_SizeLeaf(int(size)))
+        base = self._sched_describe(fake, [], [], groups,
+                                    self._bucket_chunk(),
+                                    wire=self.wire_dtype)
+        lplan = ",".join(f"{key}:{len(leaves)}" for key, leaves in plan)
+        return base + " lplan=" + lplan
 
     # ---------------------------------------------- staged pipeline
 
@@ -1024,20 +1137,37 @@ class CrossSliceAllReduce:
         return buf
 
     def _stage_wire(self, dtype_str: str, count: int) -> np.ndarray:
-        """Persistent bf16 wire buffer for a compressed dtype group
-        (the ring reduces THIS buffer; _staging keeps the f32 bytes
-        for gather/residual math)."""
-        import ml_dtypes
+        """Persistent compressed wire buffer for a dtype group (the
+        ring reduces THIS buffer; _staging keeps the f32 bytes for
+        gather/residual math). bf16 buffers are ring-registered (the
+        ring folds them in place over the MR); int8 buffers are plain
+        host memory — the q8 schedule stages through ring scratch and
+        never posts against the caller buffer."""
+        if self.wire_dtype == "int8":
+            wdt = np.dtype(np.int8)
+        else:
+            import ml_dtypes
+            wdt = np.dtype(ml_dtypes.bfloat16)
 
         buf = self._wire_staging.get(dtype_str)
-        if buf is None or buf.size < count:
-            if buf is not None:
+        if buf is not None and buf.dtype != wdt:
+            # Wire dtype changed under a live shim (test harness):
+            # drop the old buffer's ring bindings before replacing.
+            if buf.dtype != np.int8:
                 dropped = self._drop_slice_regs("w:" + dtype_str)
                 if buf.ctypes.data not in dropped:
                     self.world.ring.unregister_buffer(buf)
-            buf = np.empty(count, dtype=ml_dtypes.bfloat16)
+            buf = None
+            self._wire_staging.pop(dtype_str, None)
+        if buf is None or buf.size < count:
+            if buf is not None and wdt != np.int8:
+                dropped = self._drop_slice_regs("w:" + dtype_str)
+                if buf.ctypes.data not in dropped:
+                    self.world.ring.unregister_buffer(buf)
+            buf = np.empty(count, dtype=wdt)
             self._wire_staging[dtype_str] = buf
-            self.world.ring.register_buffer(buf)
+            if wdt != np.int8:
+                self.world.ring.register_buffer(buf)
         return buf
 
     def _residual(self, dtype_str: str, count: int) -> np.ndarray:
@@ -1169,9 +1299,11 @@ class _PendingSync:
         with trace.span("xslice.bucket_scatter", seg=k,
                         lane=(k % 14) + 1, rank=shim.world.rank,
                         bytes=n * itemsize, coll=coll):
-            if compress:
+            if compress and shim.wire_dtype == "bf16":
                 # Decompress the reduced bf16 wire bytes back into the
-                # f32 staging slice the scatter below reads.
+                # f32 staging slice the scatter below reads. (The int8
+                # schedule needs no copy here: the native q8 allreduce
+                # dequantized straight into this f32 slice.)
                 wbuf = shim._wire_staging[dtype_str]
                 np.copyto(buf[o:o + n],
                           wbuf[o:o + n].astype(np.float32))
@@ -1255,3 +1387,257 @@ class _PendingSync:
             self._result = self._jax.tree_util.tree_unflatten(
                 self._treedef, self._out)
         return self._result
+
+
+class _LayeredDeferred:
+    """Per-layer pending object for the verbs (pinning) degrade: the
+    gradient taps' pushes are ignored (their host copies are cheap and
+    the program is unchanged) and ``finish(tree)`` runs the fused
+    synchronous sync — per-step MR teardown cannot outlive an async
+    handle, exactly the ``_DeferredSync`` rationale."""
+
+    def __init__(self, shim: CrossSliceAllReduce):
+        self._shim = shim
+
+    def push(self, idx: int, leaves) -> None:
+        pass  # fused sync at finish() reduces the jit-returned tree
+
+    def finish(self, tree):
+        with trace.span("xslice.sync", rank=self._shim.world.rank):
+            return self._shim._sync(tree)
+
+
+class _LayeredSync:
+    """In-flight per-layer sync (``CrossSliceAllReduce.start_layered``).
+
+    The trainer's gradient taps call ``push(idx, leaves)`` from the
+    jitted backward pass (ordered io_callback): each push stages that
+    layer bucket's gradients (compressing with error feedback when a
+    wire dtype is configured) and launches its allreduce NONBLOCKING —
+    the wire of bucket k rides under the compute of layer k-1's
+    backward. Pushes are serialized by the io_callback ordering and
+    arrive in the program's backward order, identical on every rank,
+    so the async submission order satisfies the SPMD contract without
+    any cross-rank coordination beyond the digest check at open.
+
+    ``push`` NEVER raises (it runs inside the XLA callback machinery,
+    where an exception would poison the whole computation): the first
+    failure is recorded and re-raised from ``finish()``, after every
+    launched handle has been drained."""
+
+    def __init__(self, shim: CrossSliceAllReduce, plan):
+        self._shim = shim
+        self._plan = plan
+        self._cv = threading.Condition()
+        self._arrived = [False] * len(plan)
+        self._handles: List[tuple] = []  # (segment, handle) launch order
+        self._err: Optional[BaseException] = None
+
+        describe = shim._layered_describe(plan)
+        check = getattr(shim.world, "check_schedule", None)
+        if check is not None:
+            check(hashlib.sha256(describe.encode()).digest(), describe)
+        shim._step_token = None
+
+        # Segment layout: within each bucket, consecutive same-dtype
+        # leaves form one segment; segments pack bucket-major into the
+        # per-dtype staging buffers, so the layout — and therefore the
+        # error-feedback residual addressing — is stable across steps.
+        self._segs: List[List[tuple]] = []  # per bucket:
+        #   (dtype_str, off, n, [leaf sizes], [global leaf indices])
+        totals: Dict[str, int] = {}
+        gidx = 0
+        for _key, leaves in plan:
+            bucket_segs: List[tuple] = []
+            cur = None  # [dtype, off, n, sizes, gidxs]
+            for size, dtype_str in leaves:
+                size = int(size)
+                if cur is not None and cur[0] == dtype_str:
+                    cur[2] += size
+                    cur[3].append(size)
+                    cur[4].append(gidx)
+                else:
+                    if cur is not None:
+                        bucket_segs.append(tuple(cur))
+                    off = totals.get(dtype_str, 0)
+                    cur = [dtype_str, off, size, [size], [gidx]]
+                gidx += 1
+                totals[dtype_str] = totals.get(dtype_str, 0) + size
+            if cur is not None:
+                bucket_segs.append(tuple(cur))
+            self._segs.append(bucket_segs)
+        self._n_leaves = gidx
+
+        # Front-load staging buffers, MR slices, and (for compressed
+        # f32) the wire buffer + EF residual — steady-state pushes
+        # post work requests only.
+        self._bufs: Dict[str, np.ndarray] = {}
+        self._wbufs: Dict[str, np.ndarray] = {}
+        self._res: Dict[str, np.ndarray] = {}
+        q8 = shim.wire_dtype == "int8"
+        for dtype_str, total in totals.items():
+            buf = shim._stage(dtype_str, total)
+            self._bufs[dtype_str] = buf
+            compress = (shim.wire_dtype is not None
+                        and dtype_str == "float32")
+            if compress:
+                self._wbufs[dtype_str] = shim._stage_wire(dtype_str, total)
+                self._res[dtype_str] = shim._residual(dtype_str, total)
+            itemsize = np.dtype(dtype_str).itemsize
+            staging.add(total * itemsize * 2)  # D2H + H2D round trip
+            if not (compress and q8):
+                target = (self._wbufs[dtype_str] if compress else buf)
+                reg_key = ("w:" if compress else "s:") + dtype_str
+                for segs in self._segs:
+                    for dt, off, n, _sz, _gi in segs:
+                        if dt == dtype_str:
+                            shim._register_slice(reg_key,
+                                                 target[off:off + n])
+        trace.event("xslice.layered_open", buckets=len(plan),
+                    leaves=self._n_leaves,
+                    wire=shim.wire_dtype or "f32")
+
+    def push(self, idx: int, leaves) -> None:
+        """Stage + launch bucket ``idx``'s segments from its concrete
+        host gradient leaves (tree order). Called from the backward
+        pass's ordered io_callback — never raises; failures surface
+        from ``finish()``."""
+        shim = self._shim
+        try:
+            if self._err is None:
+                segs = self._segs[idx]
+                nbytes = sum(n * np.dtype(dt).itemsize
+                             for dt, _o, n, _sz, _gi in segs)
+                with trace.span("xslice.layer_stage", bucket=idx,
+                                lane=(idx % 14) + 1,
+                                rank=shim.world.rank, bytes=nbytes):
+                    li = 0
+                    for dt, off, n, sizes, _gidxs in segs:
+                        buf = self._bufs[dt]
+                        o = off
+                        for sz in sizes:
+                            flat = np.asarray(leaves[li]).reshape(-1)
+                            buf[o:o + sz] = flat
+                            o += sz
+                            li += 1
+                        compress = (shim.wire_dtype is not None
+                                    and dt == "float32")
+                        if compress:
+                            seg = buf[off:off + n]
+                            res = self._res[dt][off:off + n]
+                            wbuf = self._wbufs[dt]
+                            seg += res
+                            if shim.wire_dtype == "int8":
+                                absmax = (float(np.max(np.abs(seg)))
+                                          if n else 0.0)
+                                scale = absmax / 127.0
+                                if scale > 0.0:
+                                    np.rint(seg / scale,
+                                            casting="unsafe",
+                                            out=wbuf[off:off + n])
+                                else:
+                                    wbuf[off:off + n] = 0
+                                np.subtract(
+                                    seg,
+                                    wbuf[off:off + n].astype(np.float32)
+                                    * scale,
+                                    out=res)
+                                h = shim.world.allreduce_q8_async(
+                                    wbuf[off:off + n], scale, seg)
+                            else:
+                                wbuf[off:off + n] = seg.astype(wbuf.dtype)
+                                np.subtract(
+                                    seg,
+                                    wbuf[off:off + n].astype(np.float32),
+                                    out=res)
+                                h = shim.world.allreduce_async(
+                                    wbuf[off:off + n])
+                        else:
+                            h = shim.world.allreduce_async(
+                                buf[off:off + n])
+                        self._handles.append(((dt, off, n, sizes,
+                                               _gidxs), h))
+        except BaseException as e:  # noqa: BLE001 — re-raised at finish
+            if self._err is None:
+                self._err = e
+        finally:
+            with self._cv:
+                self._arrived[idx] = True
+                self._cv.notify_all()
+
+    def finish(self, tree):
+        """Wait for every bucket to arrive and every handle to land
+        (submission order), scatter the reduced values into fresh
+        leaves shaped like ``tree``, and return the reduced tree."""
+        import jax
+
+        shim = self._shim
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if len(leaves) != self._n_leaves:
+            raise ValueError(
+                f"layered finish: template tree has {len(leaves)} "
+                f"leaves but the plan staged {self._n_leaves}")
+        out: List[Any] = list(leaves)
+        with trace.span("xslice.sync_finish", rank=shim.world.rank):
+            with self._cv:
+                ok = self._cv.wait_for(lambda: all(self._arrived),
+                                       timeout=600.0)
+            if not ok:
+                missing = [i for i, a in enumerate(self._arrived)
+                           if not a]
+                self._drain()
+                raise RuntimeError(
+                    f"layered sync: buckets {missing} never delivered "
+                    "gradients (backward tap did not fire)")
+            if self._err is not None:
+                self._drain()
+                raise self._err
+            for hi, (seg, h) in enumerate(self._handles):
+                dt, off, n, sizes, gidxs = seg
+                try:
+                    h.wait()
+                except BaseException:
+                    self._drain(hi + 1)
+                    raise
+                buf = self._bufs[dt]
+                if (shim.wire_dtype == "bf16" and dt == "float32"):
+                    # Decompress reduced bf16 back into the f32 slice
+                    # the scatter reads (int8 needs no copy: the
+                    # native q8 path dequantized into it already).
+                    wbuf = self._wbufs[dt]
+                    np.copyto(buf[off:off + n],
+                              wbuf[off:off + n].astype(np.float32))
+                o = off
+                for sz, gi in zip(sizes, gidxs):
+                    piece = buf[o:o + sz]
+                    o += sz
+                    fresh = np.empty(np.shape(leaves[gi]),
+                                     dtype=piece.dtype)
+                    flat = fresh.reshape(-1)
+                    if not shim.mean:
+                        np.copyto(flat, piece)
+                    elif piece.dtype.kind in "iu":
+                        np.floor_divide(piece, shim.world.world, out=flat)
+                    else:
+                        np.divide(piece,
+                                  np.asarray(shim.world.world,
+                                             dtype=piece.dtype),
+                                  out=flat)
+                    if isinstance(leaves[gi], np.ndarray):
+                        out[gi] = fresh
+                    else:
+                        out[gi] = jax.device_put(fresh,
+                                                 leaves[gi].sharding)
+            trace.event("xslice.allreduce", leaves=self._n_leaves,
+                        zero_copy=0, staged=self._n_leaves,
+                        layered=len(self._plan))
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _drain(self, start: int = 0) -> None:
+        """Drain every handle from ``start`` on — nothing may stay on
+        the wire when an error propagates into the rebuild ladder."""
+        for _seg, h in self._handles[start:]:
+            try:
+                h.wait()
+            except Exception:
+                pass

@@ -196,6 +196,16 @@ int tdr_qp_has_seal_payload(tdr_qp *qp);
  * the pre-trace-id framing. */
 int tdr_qp_has_coll_id(tdr_qp *qp);
 
+/* 1 when FEAT_WIRE_Q8 was negotiated on this QP: both ends accept the
+ * int8 quantized ring schedule (tdr_ring_allreduce_q8). The quantized
+ * pieces are ordinary sealed SEND payloads ([f32 scale][int8 bytes]) —
+ * no frame-format change, so with the feature off the wire is
+ * byte-identical to the legacy framing; the bit gates the SCHEDULE and
+ * lets the health ladder query per-link int8 capability before
+ * engaging its rung below bf16. TDR_NO_WIRE_Q8 suppresses the
+ * advertisement. */
+int tdr_qp_has_wire_q8(tdr_qp *qp);
+
 /* Hung-peer probe: send a zero-byte PING (sealed with a tag-only CRC
  * on sealed connections) and wait up to timeout_ms for the peer's
  * progress engine to PONG it back. Returns 1 = peer alive, 0 = no
@@ -500,6 +510,10 @@ enum {
   TDR_DT_BF16 = 4, /* accumulated in f32 */
   TDR_DT_U8 = 5,   /* byte transport (alltoall/all_gather/broadcast);
                       reducing collectives reject it */
+  TDR_DT_I8 = 6,   /* int8 wire compression: quantized payload of the
+                      scale-carrying q8 schedule. Plain reducing
+                      collectives reject it (a scale-less int8 sum
+                      overflows); use tdr_ring_allreduce_q8. */
 };
 
 enum { TDR_RED_SUM = 0, TDR_RED_MAX = 1, TDR_RED_MIN = 2 };
@@ -567,6 +581,23 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype);
  * reduction. */
 int tdr_ring_reduce(tdr_ring *r, void *data, size_t count, int dtype,
                     int red_op, int root);
+/* int8 wire-compressed allreduce (FEAT_WIRE_Q8 on every channel QP,
+ * else fails fast): `q8` holds count int8 elements quantized with the
+ * symmetric per-bucket `scale_in` (true value = q[i] * scale_in, the
+ * caller computed scale_in = absmax/127 and keeps the error-feedback
+ * residual). Runs the textbook RS+AG ring but each wire piece is
+ * [f32 running scale][int8 segment] inside an ordinary sealed SEND
+ * payload, and the fold REQUANTIZES under the summed scale
+ * (q := round((s_l*q_l + s_f*q_f)/(s_l+s_f))) so magnitudes never
+ * clip no matter the world size. The all-gather circulates the
+ * reduced [scale][q8] pieces verbatim, so every rank dequantizes
+ * IDENTICAL bits: f32_out[i] = q[i] * scale_of_segment, bitwise equal
+ * across ranks. `q8` is scratch (destroyed); f32_out receives the
+ * count-element f32 result and may be any host buffer (never posted
+ * to the wire). Wire bytes ~= half of the bf16 schedule's for the
+ * same count (+4 bytes of scale per piece). */
+int tdr_ring_allreduce_q8(tdr_ring *r, void *q8, size_t count,
+                          float scale_in, float *f32_out);
 /* Front-load registration for a caller-stable buffer; allreduces on it
  * post work requests only. Unregistered buffers are registered per
  * call (safe for arbitrary/recycled addresses, slower). */
@@ -633,6 +664,11 @@ tdr_ring_op *tdr_ring_start_reduce_scatter(tdr_ring *r, void *data,
                                            int red_op);
 tdr_ring_op *tdr_ring_start_all_gather(tdr_ring *r, void *data,
                                        size_t count, int dtype);
+/* Nonblocking tdr_ring_allreduce_q8 — same driver, submission-order,
+ * and failure contract as tdr_ring_start. Both `q8` and `f32_out`
+ * must stay alive and untouched until the handle completes. */
+tdr_ring_op *tdr_ring_start_q8(tdr_ring *r, void *q8, size_t count,
+                               float scale_in, float *f32_out);
 /* The BYTE offset/length of the segment this rank owns after a
  * reduce-scatter of `count` elements of `dtype` — the same
  * (rank+1) % world convention and remainder layout the collectives
@@ -663,6 +699,7 @@ enum {
   TDR_SCHED_FUSED2 = 2,   /* world-2 fused exchange */
   TDR_SCHED_FUSED2_FB = 3,/* world-2 fused exchange with foldback */
   TDR_SCHED_WAVEFRONT = 4,/* world>2 flattened wavefront */
+  TDR_SCHED_Q8 = 5,       /* int8 scale-carrying RS+AG (allreduce_q8) */
 };
 int tdr_ring_last_schedule(const tdr_ring *r);
 

@@ -73,6 +73,10 @@ int tdr_qp_has_coll_id(tdr_qp *qp) {
   return reinterpret_cast<Qp *>(qp)->has_coll_id() ? 1 : 0;
 }
 
+int tdr_qp_has_wire_q8(tdr_qp *qp) {
+  return reinterpret_cast<Qp *>(qp)->has_wire_q8() ? 1 : 0;
+}
+
 int tdr_qp_probe(tdr_qp *qp, int timeout_ms) {
   return reinterpret_cast<Qp *>(qp)->probe(timeout_ms);
 }

@@ -162,6 +162,10 @@ class Qp {
   // trace id to the peer; emu only, and only when both ends were
   // recording at handshake time).
   virtual bool has_coll_id() const { return false; }
+  // Whether FEAT_WIRE_Q8 was negotiated: both ends are willing to run
+  // the int8 quantized ring schedule (tdr_ring_allreduce_q8). Queried
+  // per link by the health ladder's int8 rung.
+  virtual bool has_wire_q8() const { return false; }
   // Link identity for fault riders and health attribution: the ring
   // layer stamps (lane, self rank, peer rank) at channel bring-up so
   // netem clauses can scope to one link and the probe/stall telemetry
@@ -318,6 +322,16 @@ enum : uint32_t {
   // frames stay byte-identical to the legacy wire format
   // (TDR_NO_PROBE acts at the advertising stage).
   FEAT_PROBE = 1u << 5,
+  // int8 wire compression (tdr_ring_allreduce_q8): willingness to run
+  // the quantized ring schedule, whose pieces carry a per-segment f32
+  // scale IN the sealed payload ([scale][q8 bytes] over ordinary
+  // SEND/recv frames — no frame-format change, so frames stay
+  // byte-identical with the feature off; the bit exists because the
+  // SCHEDULE differs and per-link capability must be queryable by the
+  // health ladder before it downgrades a degraded link to int8).
+  // Schedule-changing like FEAT_FUSED2, so negotiated (mine & theirs);
+  // TDR_NO_WIRE_Q8 acts at the advertising stage.
+  FEAT_WIRE_Q8 = 1u << 6,
 };
 
 // Locally-willing feature set (TDR_NO_FOLDBACK / TDR_NO_FUSED2 act
@@ -440,6 +454,15 @@ int seal_retry_budget();
 
 // Element size for a TDR_DT_*; 0 for unknown.
 size_t dtype_size(int dt);
+// int8 wire-compression kernels (next to the bf16 fold kernels in
+// util.cc). fold_q8: requantizing dequant-fold of two symmetric-scale
+// int8 vectors — q_l[i] := round((s_l*q_l[i] + s_f*q_f[i]) / (s_l +
+// s_f)), the running-scale rule that keeps |q| <= 127 at every hop of
+// the ring without clipping (the caller advances its scale to
+// s_l + s_f). dequant_q8: out[i] = q[i] * scale.
+void fold_q8(int8_t *q_l, float s_l, const int8_t *q_f, float s_f,
+             size_t n);
+void dequant_q8(float *out, const int8_t *q, size_t n, float scale);
 // dst[i] op= src[i] for n elements of dtype dt (bf16 accumulates in
 // f32 with round-to-nearest-even, matching TPU semantics).
 void reduce_any(void *dst, const void *src, size_t n, int dt, int op);

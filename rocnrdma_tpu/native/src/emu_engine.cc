@@ -831,6 +831,13 @@ class EmuQp : public Qp {
 
   bool has_coll_id() const override { return coll_wire_; }
 
+  // int8 wire compression: pure capability bit (mine & theirs at
+  // handshake, like FEAT_FUSED2) — the q8 pieces are ordinary sealed
+  // SEND payloads, so no frame parsing changes with it either way.
+  bool has_wire_q8() const override {
+    return (features_ & FEAT_WIRE_Q8) != 0;
+  }
+
   // Hung-peer probe: PING the peer's PROGRESS THREAD and wait for the
   // echoed PONG. A pong proves the peer process is alive and draining
   // its socket even though the collective is stalled — "slow, degrade"
@@ -1024,7 +1031,17 @@ class EmuQp : public Qp {
       // final.
       bool ok = par_cma_reduce2(peer_pid_, r.dst, u.src_va, u.len, r.dtype,
                                 r.red_op);
-      if (ok) tel(TDR_TEL_FOLD, u.seq, u.len, u.coll ? u.coll : r.coll);
+      if (ok) {
+        tel(TDR_TEL_FOLD, u.seq, u.len, u.coll ? u.coll : r.coll);
+        // The foldback return leg moves u.len bytes back into the
+        // sender's buffer (process_vm here, ack payload on the stream
+        // tier). It must count as wire_tx like the forward desc frame
+        // does, or foldback schedules report half their real traffic
+        // and cross-schedule byte comparisons lie. The ack itself
+        // stays bare (len 0): the FB_ACK reader consumes h.len
+        // payload bytes, and CMA already wrote the result back.
+        tel(TDR_TEL_WIRE_TX, u.seq, u.len, u.coll ? u.coll : r.coll);
+      }
       ack.status = ok ? TDR_WC_SUCCESS : TDR_WC_GENERAL_ERR;
       sent = send_frame(ack, nullptr, 0);
       complete_recv(r,
@@ -1042,6 +1059,11 @@ class EmuQp : public Qp {
     ack.status = TDR_WC_SUCCESS;
     ack.len = u.len;
     ack.coll = u.coll;
+    // The folded result riding the ack is real socket traffic —
+    // send_frame() emits no telemetry, so without this event the
+    // foldback schedule's entire return leg would be invisible to
+    // wire accounting.
+    tel(TDR_TEL_WIRE_TX, u.seq, u.len, u.coll ? u.coll : r.coll);
     sent = send_frame(ack, u.payload.data(), u.payload.size());
     complete_recv(r, {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
     return sent;
@@ -1082,6 +1104,10 @@ class EmuQp : public Qp {
     t.cseq = static_cast<uint32_t>(ack.seq);
     t.crc = seal_crc(t, ack, u.payload.data(), u.len);
     seal_count(kSealSealed);
+    // Sealed foldback returns bypass send_frame_sealed (the trailer is
+    // hand-built over the folded bytes), so emit the wire_tx event the
+    // normal sealed path would have.
+    tel(TDR_TEL_WIRE_TX, u.seq, u.len, u.coll ? u.coll : r.coll);
     bool sent = send_frame(ack, u.payload.data(), u.payload.size(), &t);
     complete_recv(r, {r.wr_id, TDR_WC_SUCCESS, TDR_OP_RECV, u.len});
     return sent;
@@ -2526,6 +2552,14 @@ class EmuQp : public Qp {
           case OP_READ_RESP:
             tel_emit(TDR_TEL_WIRE_RX, eng_->tel_id, tel_id, h.seq, h.len,
                      h.coll);
+            break;
+          case OP_SEND_FB_ACK:
+            // Stream-tier foldback acks carry the folded result as
+            // payload; CMA acks are bare (len 0) because the result
+            // was written back before acking — only count the former.
+            if (h.len)
+              tel_emit(TDR_TEL_WIRE_RX, eng_->tel_id, tel_id, h.seq, h.len,
+                       h.coll);
             break;
           default:
             break;

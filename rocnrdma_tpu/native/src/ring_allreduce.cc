@@ -289,9 +289,19 @@ struct tdr_ring_op {
   // Which collective the driver runs for this op: the async surface
   // covers the allreduce AND its standalone phases (the hierarchical
   // schedule chains reduce-scatter → delegate allreduce → all-gather
-  // through these handles).
-  enum { kAllreduce = 0, kReduceScatter = 1, kAllGather = 2 };
+  // through these handles), plus the int8 wire-compressed allreduce.
+  enum {
+    kAllreduce = 0,
+    kReduceScatter = 1,
+    kAllGather = 2,
+    kAllreduceQ8 = 3
+  };
   int kind = kAllreduce;
+  // kAllreduceQ8 only: the per-bucket symmetric scale the caller
+  // quantized with, and the f32 output buffer the dequantized result
+  // lands in (both ride the op because the driver runs it later).
+  float scale_in = 0.0f;
+  float *f32_out = nullptr;
   // Collective trace id captured at SUBMISSION (the caller stamps the
   // ring, then starts): the driver re-arms it when the op actually
   // runs, so a queue of bucketed ops keeps per-op ids whatever the
@@ -370,6 +380,10 @@ void async_driver(tdr_ring *r) {
         break;
       case tdr_ring_op::kAllGather:
         rc = tdr_ring_all_gather(r, op->data, op->count, op->dtype);
+        break;
+      case tdr_ring_op::kAllreduceQ8:
+        rc = tdr_ring_allreduce_q8(r, op->data, op->count, op->scale_in,
+                                   op->f32_out);
         break;
       default:
         rc = tdr_ring_allreduce(r, op->data, op->count, op->dtype,
@@ -519,7 +533,9 @@ void tdr_ring_destroy(tdr_ring *r) {
 }
 
 static tdr_ring_op *ring_start_kind(tdr_ring *r, void *data, size_t count,
-                                    int dtype, int red_op, int kind) {
+                                    int dtype, int red_op, int kind,
+                                    float scale_in = 0.0f,
+                                    float *f32_out = nullptr) {
   if (!r || !data) {
     tdr::set_error("ring_start: null ring or data");
     return nullptr;
@@ -535,12 +551,21 @@ static tdr_ring_op *ring_start_kind(tdr_ring *r, void *data, size_t count,
         "ring_start: u8 is byte-transport only (no fold semantics)");
     return nullptr;
   }
+  // int8 only reduces through the scale-carrying q8 schedule (a plain
+  // int8 sum overflows); byte transport via all_gather is fine.
+  if (dtype == TDR_DT_I8 && kind != tdr_ring_op::kAllGather &&
+      kind != tdr_ring_op::kAllreduceQ8) {
+    tdr::set_error("ring_start: i8 reduces only via tdr_ring_start_q8");
+    return nullptr;
+  }
   auto *op = new tdr_ring_op();
   op->data = data;
   op->count = count;
   op->dtype = dtype;
   op->red_op = red_op;
   op->kind = kind;
+  op->scale_in = scale_in;
+  op->f32_out = f32_out;
   // Capture the caller-stamped trace id NOW (submission order is the
   // SPMD contract, so submission is when the id binds); the driver
   // re-arms it when the op runs.
@@ -579,6 +604,16 @@ tdr_ring_op *tdr_ring_start_all_gather(tdr_ring *r, void *data,
                                        size_t count, int dtype) {
   return ring_start_kind(r, data, count, dtype, TDR_RED_SUM,
                          tdr_ring_op::kAllGather);
+}
+
+tdr_ring_op *tdr_ring_start_q8(tdr_ring *r, void *q8, size_t count,
+                               float scale_in, float *f32_out) {
+  if (!f32_out) {
+    tdr::set_error("ring_start_q8: null f32_out");
+    return nullptr;
+  }
+  return ring_start_kind(r, q8, count, TDR_DT_I8, TDR_RED_SUM,
+                         tdr_ring_op::kAllreduceQ8, scale_in, f32_out);
 }
 
 int tdr_ring_owned_segment(tdr_ring *r, size_t count, int dtype,
@@ -2219,6 +2254,12 @@ int tdr_ring_allreduce(tdr_ring *r, void *data, size_t count, int dtype,
     tdr::set_error("ring_allreduce: u8 is byte-transport only (no fold semantics)");
     return -1;
   }
+  if (dtype == TDR_DT_I8) {
+    tdr::set_error(
+        "ring_allreduce: i8 reduces only via tdr_ring_allreduce_q8 "
+        "(a scale-less int8 sum overflows)");
+    return -1;
+  }
   if (count == 0) return 0;
   // Fault-plan site "ring" (TDR_FAULT_PLAN, fault.cc): a transient
   // collective failure injected BEFORE any posting — the recovery
@@ -2400,8 +2441,8 @@ int tdr_ring_reduce_scatter(tdr_ring *r, void *data, size_t count,
     tdr::set_error("ring: bad dtype");
     return -1;
   }
-  if (dtype == TDR_DT_U8) {
-    tdr::set_error("ring_reduce_scatter: u8 is byte-transport only (no fold semantics)");
+  if (dtype == TDR_DT_U8 || dtype == TDR_DT_I8) {
+    tdr::set_error("ring_reduce_scatter: u8/i8 is byte-transport only (no fold semantics)");
     return -1;
   }
   std::lock_guard<std::mutex> g(r->mu);
@@ -2558,8 +2599,8 @@ int tdr_ring_reduce(tdr_ring *r, void *data, size_t count, int dtype,
     tdr::set_error("ring: bad dtype");
     return -1;
   }
-  if (dtype == TDR_DT_U8) {
-    tdr::set_error("ring_reduce: u8 is byte-transport only (no fold semantics)");
+  if (dtype == TDR_DT_U8 || dtype == TDR_DT_I8) {
+    tdr::set_error("ring_reduce: u8/i8 is byte-transport only (no fold semantics)");
     return -1;
   }
   std::lock_guard<std::mutex> g(r->mu);
@@ -2811,6 +2852,134 @@ int tdr_ring_alltoall(tdr_ring *r, void *data, size_t count, int dtype) {
   }
   release_big_scratch(r, total);
   return tel.finish(0);
+}
+
+/* int8 wire-compressed allreduce (see tdr.h): the textbook RS+AG ring
+ * where every wire piece is [f32 running scale][int8 segment] inside
+ * an ordinary sealed SEND payload — no frame-format change, so seal
+ * verification and the NAK/retransmit heal apply to the compressed
+ * pieces exactly as to any other payload. The fold REQUANTIZES under
+ * the summed scale (fold_q8, util.cc), so magnitudes never clip at
+ * any world size; the all-gather then circulates the reduced
+ * [scale][q8] pieces VERBATIM, which is what makes the final dequant
+ * bitwise identical on every rank (each segment's bits were produced
+ * once, by its owner's fold chain, in ring order). Pieces stage
+ * through the ring-owned scratch MR (the alltoall staging precedent):
+ * the caller's q8/f32_out buffers never touch the wire, so no
+ * per-call data MR and no quiesce-before-dereg hazard on failure. */
+int tdr_ring_allreduce_q8(tdr_ring *r, void *q8, size_t count,
+                          float scale_in, float *f32_out) {
+  if (!r || !q8 || !f32_out) {
+    tdr::set_error("ring_allreduce_q8: null ring or buffer");
+    return -1;
+  }
+  // Capability gate, fatal: the peer must run the SAME quantized
+  // schedule (piece sizes halve), so an un-negotiated ring fails fast
+  // here instead of desynchronizing the wire. The Python digest pins
+  // the fleet-wide agreement; this pins the per-link handshake.
+  for (size_t c = 0; c < r->lefts.size(); c++) {
+    if (!tdr_qp_has_wire_q8(r->lefts[c]) ||
+        !tdr_qp_has_wire_q8(r->rights[c])) {
+      tdr::set_error(
+          "ring_allreduce_q8: FEAT_WIRE_Q8 not negotiated on this ring "
+          "(legacy peer or TDR_NO_WIRE_Q8)");
+      return -1;
+    }
+  }
+  if (count == 0) return 0;
+  // Same deterministic fault trigger as the blocking allreduce.
+  {
+    int f = tdr::fault_point("ring");
+    if (f >= 0) {
+      tdr::set_error("ring: fault injected (completion error status " +
+                     std::to_string(f) + ")");
+      return -1;
+    }
+  }
+  std::lock_guard<std::mutex> g(r->mu);
+  const int world = r->world;
+  int8_t *q = static_cast<int8_t *>(q8);
+  RingTelScope tel(r, count);  // semantic payload: count int8 bytes
+  r->last_sched = TDR_SCHED_Q8;
+
+  // esz 1: offsets/lengths are in elements AND bytes.
+  std::vector<size_t> seg_off, seg_len;
+  seg_layout(world, count, 1, &seg_off, &seg_len);
+  size_t max_len = 0;
+  for (size_t l : seg_len) max_len = std::max(max_len, l);
+  const size_t piece = sizeof(float) + max_len;
+  // Scratch: [send piece][recv piece]. Sends drain fully (send acked)
+  // before the next step restages, so one slot each suffices.
+  tdr_mr *smr = r->scratch(2 * piece);
+  if (!smr) return tel.finish(-1);
+  char *sb = r->tmp.data();
+  char *rb = r->tmp.data() + piece;
+
+  // Per-segment running scales: every rank starts from its own
+  // per-bucket scale; a fold advances the receiving segment's scale
+  // to the sum of the contributions folded so far.
+  std::vector<float> scales(static_cast<size_t>(world), scale_in);
+
+  // One ring step: stage [scale][q8] of send_seg, exchange with the
+  // neighbors (recv posted before send, ChainPump discipline). Empty
+  // segments still move their 4-byte scale header so the step count
+  // stays uniform across ranks whatever count % world is.
+  auto xfer = [&](int send_seg, int recv_seg) -> int {
+    std::memcpy(sb, &scales[static_cast<size_t>(send_seg)], sizeof(float));
+    std::memcpy(sb + sizeof(float), q + seg_off[send_seg],
+                seg_len[send_seg]);
+    ChainPump pump{r, /*n_recv=*/1, /*n_send=*/1, 1, 1, /*head=*/true,
+                   "ring(q8)"};
+    return pump.run(
+        [&](size_t) {
+          return tdr_post_recv(r->left, smr, piece,
+                               sizeof(float) + seg_len[recv_seg],
+                               kWrRecv | 0);
+        },
+        [&](size_t) {
+          return tdr_post_send(r->right, smr, 0,
+                               sizeof(float) + seg_len[send_seg],
+                               kWrSend | 0);
+        });
+  };
+
+  // Phase 1: reduce-scatter with the requantizing dequant-fold —
+  // run_rs_phase's segment walk, piece-sized steps.
+  int rc = 0;
+  for (int s = 0; s < world - 1 && rc == 0; s++) {
+    int send_seg = ((r->rank - s) % world + world) % world;
+    int recv_seg = ((r->rank - s - 1) % world + world) % world;
+    rc = xfer(send_seg, recv_seg);
+    if (rc != 0) break;
+    float s_f;
+    std::memcpy(&s_f, rb, sizeof(float));
+    tdr::fold_q8(q + seg_off[recv_seg],
+                 scales[static_cast<size_t>(recv_seg)],
+                 reinterpret_cast<const int8_t *>(rb + sizeof(float)),
+                 s_f, seg_len[recv_seg]);
+    scales[static_cast<size_t>(recv_seg)] += s_f;
+  }
+
+  // Phase 2: all-gather — the reduced [scale][q8] pieces circulate
+  // verbatim (byte transport, no refold), run_ag_phase's walk.
+  for (int s = 0; s < world - 1 && rc == 0; s++) {
+    int send_seg = ((r->rank + 1 - s) % world + world) % world;
+    int recv_seg = ((r->rank - s) % world + world) % world;
+    rc = xfer(send_seg, recv_seg);
+    if (rc != 0) break;
+    std::memcpy(&scales[static_cast<size_t>(recv_seg)], rb,
+                sizeof(float));
+    std::memcpy(q + seg_off[recv_seg], rb + sizeof(float),
+                seg_len[recv_seg]);
+  }
+
+  if (rc == 0) {
+    for (int i = 0; i < world; i++)
+      tdr::dequant_q8(f32_out + seg_off[i], q + seg_off[i], seg_len[i],
+                      scales[static_cast<size_t>(i)]);
+    release_big_scratch(r, 2 * piece);
+  }
+  return tel.finish(rc);
 }
 
 }  // extern "C"

@@ -15,6 +15,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <cstdlib>
 #include <thread>
 
@@ -80,6 +81,11 @@ uint32_t local_features() {
   // escalation path asks for them — but TDR_NO_PROBE drops the
   // advertisement so legacy-wire tests can pin byte-identical frames.
   if (!env_set("TDR_NO_PROBE")) f |= FEAT_PROBE;
+  // int8 wire compression: on by default (the quantized pieces are
+  // ordinary sealed SENDs, so advertising costs nothing on the wire);
+  // TDR_NO_WIRE_Q8 drops it so byte-neutrality tests can pin that the
+  // feature-off wire is identical and the q8 schedule refuses to run.
+  if (!env_set("TDR_NO_WIRE_Q8")) f |= FEAT_WIRE_Q8;
   return f;
 }
 
@@ -189,6 +195,7 @@ size_t dtype_size(int dt) {
     case TDR_DT_BF16:
       return 2;
     case TDR_DT_U8:
+    case TDR_DT_I8:
       return 1;
     default:
       return 0;
@@ -408,6 +415,44 @@ void reduce_any(void *dst, const void *src, size_t n, int dt, int op) {
                   static_cast<const uint16_t *>(src), n, op);
       break;
   }
+}
+
+// ------------------------------------------------------------------
+// int8 wire-compression kernels — the q8 schedule's counterparts of
+// the bf16 fold above. The fold is a REQUANTIZING dequant-fold: both
+// operands are dequantized under their own symmetric scales, summed
+// in f32, and requantized under the SUMMED scale s_l + s_f. Because
+// |s_l*q_l + s_f*q_f| <= (s_l + s_f) * 127, the requantized magnitude
+// never exceeds 127 at any hop of the ring — no clipping, so the
+// per-rank error-feedback residual stays the only loss the trainer
+// has to absorb (plus one bounded rounding per hop, the bf16
+// schedule's round-per-fold analogue).
+
+void fold_q8(int8_t *q_l, float s_l, const int8_t *q_f, float s_f,
+             size_t n) {
+  float s_n = s_l + s_f;
+  if (s_n == 0.0f) {
+    // Both buckets all-zero (absmax 0 on every contributing rank).
+    memset(q_l, 0, n);
+    return;
+  }
+  float inv = 1.0f / s_n;
+  for (size_t i = 0; i < n; i++) {
+    float v = (s_l * static_cast<float>(q_l[i]) +
+               s_f * static_cast<float>(q_f[i])) *
+              inv;
+    long r = lrintf(v);
+    // Mathematically |r| <= 127; the clamp only guards fp-rounding at
+    // the boundary.
+    if (r > 127) r = 127;
+    if (r < -127) r = -127;
+    q_l[i] = static_cast<int8_t>(r);
+  }
+}
+
+void dequant_q8(float *out, const int8_t *q, size_t n, float scale) {
+  for (size_t i = 0; i < n; i++)
+    out[i] = static_cast<float>(q[i]) * scale;
 }
 
 void tune_socket(int fd) {

@@ -20,12 +20,15 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import functools
+import math
 import os
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
+from jax.experimental import io_callback
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from rocnrdma_tpu.models.llama import (
@@ -40,6 +43,33 @@ def loss_fn(model: Llama, params, tokens) -> jnp.ndarray:
     """Next-token cross entropy on (B, S) int32 tokens."""
     logits = model.apply(params, tokens[:, :-1])
     return cross_entropy_loss(logits, tokens[:, 1:])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _grad_tap(cb, idx, tree):
+    """Identity on a parameter subtree whose BACKWARD rule delivers
+    the subtree's concrete cotangent — the layer's gradients — to a
+    host collector (``cb(idx, grads)``) via ordered io_callback, the
+    moment XLA's backward pass finishes accumulating it. The forward
+    value and the cotangent pass through UNCHANGED, so the jitted
+    step's outputs are bitwise those of the untapped program; the
+    ``ordered=True`` token chain makes the delivery order the
+    program's backward order — identical on every rank, which is what
+    keeps the per-layer allreduce submission order SPMD without any
+    cross-rank coordination."""
+    return tree
+
+
+def _grad_tap_fwd(cb, idx, tree):
+    return tree, None
+
+
+def _grad_tap_bwd(cb, idx, _res, ct):
+    io_callback(lambda g: cb(idx, g), None, ct, ordered=True)
+    return (ct,)
+
+
+_grad_tap.defvjp(_grad_tap_fwd, _grad_tap_bwd)
 
 
 @dataclasses.dataclass
@@ -262,8 +292,60 @@ class Trainer:
                                replicated(self.mesh)))
         self._data_sharding = data_sharding
 
+        # Per-layer backward overlap (cross_slice_sync with
+        # per_layer=True): tap every top-level parameter subtree
+        # (embed, layer_i, final_norm, lm_head) with _grad_tap so the
+        # backward pass DELIVERS each layer's gradients to the pending
+        # sync as it produces them — bucket k's allreduce rides the
+        # wire while layer k-1's grads are still being computed. The
+        # bucket plan is a pure function of the abstract param tree,
+        # so every rank derives the identical plan (and the sync layer
+        # hashes it into the schedule digest before any wire work).
+        self._per_layer = bool(getattr(cross_slice_sync, "per_layer",
+                                       False)
+                               and hasattr(cross_slice_sync,
+                                           "start_layered"))
+        self._pending_layers = None
+        if self._per_layer:
+            inner = abstract["params"]
+            keys = sorted(inner)  # the dict flatten order jax uses
+            self.layer_plan = [
+                (k, [(int(math.prod(leaf.shape)), str(leaf.dtype))
+                     for leaf in jax.tree_util.tree_leaves(inner[k])])
+                for k in keys]
+
+            def tapped_grads(params, tokens):
+                def tapped_loss(p):
+                    tp = {k: _grad_tap(self._deliver_bucket, i,
+                                       p["params"][k])
+                          for i, k in enumerate(keys)}
+                    q = dict(p)
+                    q["params"] = tp
+                    return loss_fn(self.model, q, tokens)
+
+                return jax.value_and_grad(tapped_loss)(params)
+
+            with self.mesh:
+                self._jit_grads = jax.jit(
+                    tapped_grads,
+                    in_shardings=(self._pshard, data_sharding),
+                    out_shardings=(replicated(self.mesh), self._pshard))
+
     def shard_batch(self, tokens):
         return jax.device_put(tokens, self._data_sharding)
+
+    def _deliver_bucket(self, idx: int, grads_subtree) -> None:
+        """Target of the per-layer gradient taps: forward bucket
+        ``idx``'s concrete host gradients to the step's pending sync.
+        Runs inside the XLA callback machinery, so it must never
+        raise — push() records failures and finish() re-raises them."""
+        pending = self._pending_layers
+        if pending is None:
+            return  # tap fired outside a layered step (e.g. warmup)
+        try:
+            pending.push(idx, jax.tree_util.tree_leaves(grads_subtree))
+        except BaseException:  # noqa: BLE001 — surfaced at finish()
+            pass
 
     def _step_once(self, tokens) -> float:
         """One optimizer step; returns the (pre-update) loss."""
@@ -292,23 +374,54 @@ class Trainer:
                 # gradient bucket's allreduce INSIDE the grads span —
                 # as its leaves' D2H copies land — so the wire hides
                 # behind the backward pass, and the sync span shrinks
-                # to waiting the last handles + scatter. The
-                # flight-recorder overlap_fraction (wire events inside
-                # trainer.grads / total wire) measures exactly this.
+                # to waiting the last handles + scatter. With
+                # per_layer=True the launches move INSIDE the jitted
+                # backward itself (the gradient taps deliver each
+                # layer's grads as XLA produces them), so the wire
+                # rides under trainer.backward — the nested span that
+                # splits the flight recorder's overlap_fraction into
+                # compute-overlapped (inside backward) vs
+                # staging-overlapped (inside grads, outside backward).
                 overlap = (getattr(self.cross_slice_sync, "overlap",
                                    False)
                            and hasattr(self.cross_slice_sync, "start"))
+                per_layer = self._per_layer
                 pending = None
                 with trace.span("trainer.grads", step=step_no):
-                    loss, grads = self._jit_grads(self.params, tokens)
-                    if overlap:
-                        pending = self.cross_slice_sync.start(grads)
+                    if per_layer:
+                        pending = self.cross_slice_sync.start_layered(
+                            self.layer_plan)
+                        self._pending_layers = pending
+                        try:
+                            with trace.span("trainer.backward",
+                                            step=step_no):
+                                loss, grads = self._jit_grads(
+                                    self.params, tokens)
+                                # The backward span must close only
+                                # when the program (and so every tap
+                                # delivery) actually finished — async
+                                # dispatch would otherwise close it at
+                                # submit time.
+                                jax.block_until_ready(loss)
+                        finally:
+                            self._pending_layers = None
+                    else:
+                        with trace.span("trainer.backward",
+                                        step=step_no):
+                            loss, grads = self._jit_grads(self.params,
+                                                          tokens)
+                        if overlap:
+                            pending = self.cross_slice_sync.start(grads)
                 # The cross-slice hop: grads averaged across slices
                 # over the RDMA transport (staged fallback accounts
                 # its bytes), then applied locally.
                 with trace.span("trainer.sync", step=step_no):
-                    grads = (pending.finish() if pending is not None
-                             else self.cross_slice_sync(grads))
+                    if per_layer:
+                        grads = pending.finish(grads)
+                    elif pending is not None:
+                        grads = pending.finish()
+                    else:
+                        grads = self.cross_slice_sync(grads)
                 # Quarantine check BEFORE apply: gradients that passed
                 # the transport's integrity seal but came back
                 # non-finite would poison params on apply — with the

@@ -35,7 +35,7 @@ from .pager import PageSet
 __all__ = [
     "ServeConfig", "page_names", "pack_pages", "pack_llama_params",
     "toy_param_tree", "unpack_embed", "unpack_layer", "unpack_head",
-    "PagedDecoder",
+    "PagedDecoder", "JitPagedDecoder",
 ]
 
 
@@ -304,3 +304,140 @@ class PagedDecoder:
         """Final norm + lm_head → f32 logits (s, vocab)."""
         fn, lm = unpack_head(self.cfg, head_page)
         return _rmsnorm(x, fn, self.cfg.norm_eps) @ lm
+
+    # KV seam: the batcher's join streaming reads/writes per-request
+    # caches through these two methods only, so a decoder subclass may
+    # hold caches in a different container (jax arrays, below) without
+    # the batcher knowing.
+
+    def dump_kv(self, cache: Dict[str, np.ndarray], p: int) -> np.ndarray:
+        """Flatten the first ``p`` positions of K then V (the KV-join
+        wire payload). Works on any array type with ``__array__``."""
+        return np.concatenate([np.asarray(cache["k"][:, :p]).ravel(),
+                               np.asarray(cache["v"][:, :p]).ravel()])
+
+    def load_kv(self, cache: Dict[str, np.ndarray], k: np.ndarray,
+                v: np.ndarray, p: int) -> None:
+        """Write received prefill K/V into the first ``p`` positions."""
+        cache["k"][:, :p] = k
+        cache["v"][:, :p] = v
+
+
+class JitPagedDecoder(PagedDecoder):
+    """Opt-in jax-jitted paged decode (ROADMAP item 2 residual (b)).
+
+    Same page layout, same math as the numpy decoder — the layer step
+    is one ``jax.jit`` call with the per-request K/V cache buffers
+    DONATED (``donate_argnums``): XLA reuses the cache storage for the
+    updated cache output instead of allocating a fresh
+    ``(n_kv_heads, max_seq_len, head_dim)`` pair per layer per token,
+    which is what closes the gap to ``models/llama.py``'s scan decode.
+    ``layer()`` rebinds ``cache["k"]/["v"]`` to the donated outputs, so
+    the batcher's cache-dict contract is unchanged.
+
+    jax is imported INSIDE ``__init__`` — the module stays importable
+    with no jaxlib in the process (the -san/LITE contract at the top
+    of this file), and only this class pays the dependency. ``pos``
+    rides as a traced scalar (``dynamic_slice``/``dynamic_update_slice``
+    under the mask), so the jit caches exactly one executable per
+    sequence length (prefill s, then s=1), not one per position.
+    Greedy tokens match the numpy port (asserted in the serve smoke);
+    logits may differ in final-ulp summation order, which greedy
+    argmax on real models does not observe."""
+
+    def __init__(self, cfg: ServeConfig) -> None:
+        super().__init__(cfg)
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        cos = jnp.asarray(self._cos)
+        sin = jnp.asarray(self._sin)
+        eps = cfg.norm_eps
+        hd = cfg.head_dim
+        rep = cfg.n_heads // cfg.n_kv_heads
+
+        def rms(x, w):
+            ms = jnp.mean(x * x, axis=-1, keepdims=True)
+            return x * (1.0 / jnp.sqrt(ms + eps)) * w
+
+        def rope(x, pos, s):
+            c = jax.lax.dynamic_slice_in_dim(cos, pos, s, axis=0)[None]
+            sn = jax.lax.dynamic_slice_in_dim(sin, pos, s, axis=0)[None]
+            half = x.shape[-1] // 2
+            x1, x2 = x[..., :half], x[..., half:]
+            return jnp.concatenate(
+                [x1 * c - x2 * sn, x1 * sn + x2 * c], axis=-1)
+
+        def embed_fn(page, tokens):
+            return unpack_embed(cfg, page)[tokens]
+
+        def layer_fn(page, x, k_cache, v_cache, pos):
+            w = unpack_layer(cfg, page)
+            s = x.shape[0]
+            h = rms(x, w["attn_norm"])
+            q = (h @ w["wq"]).reshape(
+                s, cfg.n_heads, hd).transpose(1, 0, 2)
+            k = (h @ w["wk"]).reshape(
+                s, cfg.n_kv_heads, hd).transpose(1, 0, 2)
+            v = (h @ w["wv"]).reshape(
+                s, cfg.n_kv_heads, hd).transpose(1, 0, 2)
+            q = rope(q, pos, s)
+            k = rope(k, pos, s)
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                k_cache, k, pos, axis=1)
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                v_cache, v, pos, axis=1)
+            qg = q.reshape(cfg.n_kv_heads, rep, s, hd)
+            scores = jnp.einsum("grqd,gkd->grqk", qg,
+                                k_cache) / jnp.sqrt(jnp.float32(hd))
+            q_pos = pos + jnp.arange(s)
+            visible = (jnp.arange(cfg.max_seq_len)[None, :]
+                       <= q_pos[:, None])
+            scores = jnp.where(visible[None, None], scores, -jnp.inf)
+            m = jnp.max(scores, axis=-1, keepdims=True)
+            e = jnp.exp(scores - m)
+            probs = e / jnp.sum(e, axis=-1, keepdims=True)
+            o = jnp.einsum("grqk,gkd->grqd", probs, v_cache)
+            o = o.reshape(cfg.n_heads, s, hd).transpose(
+                1, 0, 2).reshape(s, cfg.n_heads * hd)
+            x = x + o @ w["wo"]
+            h = rms(x, w["mlp_norm"])
+            g = h @ w["w_gate"]
+            x = x + ((g * (1.0 / (1.0 + jnp.exp(-g))))
+                     * (h @ w["w_up"])) @ w["w_down"]
+            return x, k_cache, v_cache
+
+        def head_fn(page, x):
+            fn, lm = unpack_head(cfg, page)
+            return rms(x, fn) @ lm
+
+        self._embed_jit = jax.jit(embed_fn)
+        self._layer_jit = jax.jit(layer_fn, donate_argnums=(2, 3))
+        self._head_jit = jax.jit(head_fn)
+
+    def new_cache(self) -> Dict[str, Any]:
+        jnp = self._jnp
+        cfg = self.cfg
+        shape = (cfg.n_kv_heads, cfg.max_seq_len, cfg.head_dim)
+        return {"k": jnp.zeros(shape, jnp.float32),
+                "v": jnp.zeros(shape, jnp.float32)}
+
+    def embed(self, embed_page: np.ndarray, tokens: np.ndarray):
+        return self._embed_jit(embed_page,
+                               np.asarray(tokens, dtype=np.int32))
+
+    def layer(self, layer_page: np.ndarray, x, cache: Dict[str, Any],
+              pos: int):
+        x, cache["k"], cache["v"] = self._layer_jit(
+            layer_page, x, cache["k"], cache["v"], pos)
+        return x
+
+    def head(self, head_page: np.ndarray, x) -> np.ndarray:
+        return np.asarray(self._head_jit(head_page, x))
+
+    def load_kv(self, cache: Dict[str, Any], k: np.ndarray,
+                v: np.ndarray, p: int) -> None:
+        jnp = self._jnp
+        cache["k"] = cache["k"].at[:, :p].set(jnp.asarray(k))
+        cache["v"] = cache["v"].at[:, :p].set(jnp.asarray(v))

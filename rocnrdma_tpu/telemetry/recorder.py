@@ -257,10 +257,42 @@ def _warn_tainted_once(what: str, dropped: int) -> None:
         "often.", RuntimeWarning, stacklevel=3)
 
 
+def _merged_windows(events: Sequence[TelEvent],
+                    span: str) -> List[List[int]]:
+    """Sorted, overlap-merged [start_ns, end_ns] windows of every
+    Python span named ``span`` in the timeline."""
+    spans: List[List[int]] = []
+    for e in events:
+        if e.source == "python" and e.name == span and "dur_s" in e.fields:
+            end = int(e.ts_ns)
+            spans.append([end - int(float(e.fields["dur_s"]) * 1e9), end])
+    spans.sort()
+    merged: List[List[int]] = []
+    for s in spans:
+        if merged and s[0] <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], s[1])
+        else:
+            merged.append(list(s))
+    return merged
+
+
+def _count_inside(wire_ts: Sequence[int],
+                  merged: Sequence[Sequence[int]]) -> int:
+    inside = 0
+    i = 0
+    for ts in wire_ts:
+        while i < len(merged) and merged[i][1] < ts:
+            i += 1
+        if i < len(merged) and merged[i][0] <= ts:
+            inside += 1
+    return inside
+
+
 def overlap_fraction(events: Optional[Sequence[TelEvent]] = None,
                      span: str = "trainer.grads",
                      wire: Sequence[str] = ("wire_tx", "wire_rx"),
-                     dropped: Optional[int] = None
+                     dropped: Optional[int] = None,
+                     compute_span: str = "trainer.backward"
                      ) -> Dict[str, Any]:
     """Measured backward-overlap of a recorded window: the fraction of
     native WIRE events (frame tx/rx instants) whose timestamps fall
@@ -273,6 +305,23 @@ def overlap_fraction(events: Optional[Sequence[TelEvent]] = None,
     are instants of near-uniform chunk size, so the event-count ratio
     is a faithful time-share estimate.
 
+    The estimate is further SPLIT against the nested ``compute_span``
+    (``trainer.backward``, the jitted grads dispatch itself):
+
+    - ``compute_overlap_fraction`` — wire events inside the compute
+      span: traffic that rode under the backward COMPUTATION (the
+      per-layer gradient taps' launches land here). This is the
+      number the per-layer overlap gate holds, because only it proves
+      the wire hid behind work the step had to do anyway.
+    - ``staging_overlap_fraction`` — wire events inside ``span`` but
+      OUTSIDE the compute span: traffic overlapped only with the
+      post-backward gather/stage loop (the bucketed path's shape).
+      Staging overlap still beats fully-serial, but it cannot satisfy
+      a compute-overlap gate on its own.
+
+    ``overlap_fraction`` remains their sum (wire inside ``span``), so
+    existing consumers read the same number they always did.
+
     ``events`` is a merged timeline (``telemetry.timeline()``); when
     None the native ring is drained now. Spans overlapping across
     steps are merged before counting.
@@ -284,7 +333,8 @@ def overlap_fraction(events: Optional[Sequence[TelEvent]] = None,
     overflow). Nonzero taints the estimate — wire events silently
     vanished, so the fraction is skewed — and the result carries
     ``tainted=True`` plus a once-per-process RuntimeWarning instead of
-    a silently wrong number."""
+    a silently wrong number. The taint covers the split fractions the
+    same way (they derive from the same counts)."""
     if events is None:
         if dropped is None:
             dropped = _dropped_delta()
@@ -292,34 +342,32 @@ def overlap_fraction(events: Optional[Sequence[TelEvent]] = None,
     tainted = bool(dropped)
     if tainted:
         _warn_tainted_once("overlap_fraction", int(dropped))
-    spans: List[List[int]] = []
-    for e in events:
-        if e.source == "python" and e.name == span and "dur_s" in e.fields:
-            end = int(e.ts_ns)
-            spans.append([end - int(float(e.fields["dur_s"]) * 1e9), end])
     wire_ts = sorted(int(e.ts_ns) for e in events
                      if e.source == "native" and e.name in wire)
-    spans.sort()
-    merged: List[List[int]] = []
-    for s in spans:
-        if merged and s[0] <= merged[-1][1]:
-            merged[-1][1] = max(merged[-1][1], s[1])
-        else:
-            merged.append(list(s))
-    inside = 0
-    i = 0
-    for ts in wire_ts:
-        while i < len(merged) and merged[i][1] < ts:
-            i += 1
-        if i < len(merged) and merged[i][0] <= ts:
-            inside += 1
+    merged = _merged_windows(events, span)
+    compute = _merged_windows(events, compute_span)
+    inside = _count_inside(wire_ts, merged)
+    in_compute = _count_inside(wire_ts, compute)
+    # Clamp: the compute span nests inside ``span`` by construction,
+    # but a pathological timeline (clock skew, missing parent span)
+    # must not produce a negative staging share.
+    in_compute = min(in_compute, inside)
     total = len(wire_ts)
+
+    def frac(n: int) -> float:
+        return round(n / total, 4) if total else 0.0
+
     return {
         "span": span,
-        "spans": len(spans),
+        "spans": len(merged),
+        "compute_span": compute_span,
+        "compute_spans": len(compute),
         "wire_events": total,
         "wire_in_span": inside,
-        "overlap_fraction": round(inside / total, 4) if total else 0.0,
+        "wire_in_compute": in_compute,
+        "overlap_fraction": frac(inside),
+        "compute_overlap_fraction": frac(in_compute),
+        "staging_overlap_fraction": frac(inside - in_compute),
         "dropped": int(dropped or 0),
         "tainted": tainted,
     }

@@ -53,11 +53,13 @@ OP_WRITE, OP_READ, OP_SEND, OP_RECV = 0, 1, 2, 3
 
 # Datatypes / reduce ops for the ring
 DT_F32, DT_F64, DT_I32, DT_I64, DT_BF16, DT_U8 = 0, 1, 2, 3, 4, 5
+DT_I8 = 6  # int8 wire compression; reduces only via allreduce_q8
 RED_SUM, RED_MAX, RED_MIN = 0, 1, 2
 
 # Ring schedules (tdr_ring_last_schedule)
 SCHED_NONE, SCHED_GENERIC, SCHED_FUSED2, SCHED_FUSED2_FB, SCHED_WAVEFRONT = \
     0, 1, 2, 3, 4
+SCHED_Q8 = 5
 
 # Connection flags (tdr_listen_tier/tdr_connect_tier).
 _CONN_FORCE_STREAM = 1
@@ -71,6 +73,10 @@ _NUMPY_DTYPE_MAP = {
     # Byte transport only (alltoall / all_gather / broadcast); the
     # reducing collectives reject it engine-side (no fold semantics).
     "uint8": DT_U8,
+    # Quantized wire payload: reduces only through the scale-carrying
+    # q8 schedule (Ring.allreduce_q8); plain reducing collectives
+    # reject it engine-side (a scale-less int8 sum overflows).
+    "int8": DT_I8,
 }
 
 
@@ -225,6 +231,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_qp_has_seal_payload.argtypes = [P]
     lib.tdr_qp_has_coll_id.restype = ctypes.c_int
     lib.tdr_qp_has_coll_id.argtypes = [P]
+    lib.tdr_qp_has_wire_q8.restype = ctypes.c_int
+    lib.tdr_qp_has_wire_q8.argtypes = [P]
     lib.tdr_qp_probe.restype = ctypes.c_int
     lib.tdr_qp_probe.argtypes = [P, ctypes.c_int]
     lib.tdr_qp_set_link.restype = None
@@ -256,6 +264,14 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.tdr_ring_start_all_gather.restype = P
     lib.tdr_ring_start_all_gather.argtypes = [
         P, P, ctypes.c_size_t, ctypes.c_int,
+    ]
+    lib.tdr_ring_allreduce_q8.restype = ctypes.c_int
+    lib.tdr_ring_allreduce_q8.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_float, P,
+    ]
+    lib.tdr_ring_start_q8.restype = P
+    lib.tdr_ring_start_q8.argtypes = [
+        P, P, ctypes.c_size_t, ctypes.c_float, P,
     ]
     lib.tdr_ring_owned_segment.restype = ctypes.c_int
     lib.tdr_ring_owned_segment.argtypes = [
@@ -857,6 +873,18 @@ class QueuePair:
             _live(self._h, "has_coll_id")))
 
     @property
+    def has_wire_q8(self) -> bool:
+        """Both ends negotiated int8 wire compression (FEAT_WIRE_Q8):
+        the ring may run the quantized scale-carrying schedule
+        (``Ring.allreduce_q8``) over this link. The compressed pieces
+        are ordinary sealed SEND payloads — frames are byte-identical
+        with the feature off; the bit gates the SCHEDULE and lets the
+        health ladder query per-link int8 capability. TDR_NO_WIRE_Q8
+        suppresses the advertisement."""
+        return bool(_load().tdr_qp_has_wire_q8(
+            _live(self._h, "has_wire_q8")))
+
+    @property
     def telemetry_id(self) -> int:
         """Flight-recorder track id of this QP (bring-up ordinal;
         names the per-QP timeline in Perfetto exports)."""
@@ -1090,6 +1118,46 @@ class Ring:
                                    ptr, array.size, dt, op)
         _check(h, "ring_start")
         return RingOp(h, array)
+
+    def allreduce_q8(self, q8, scale: float, out) -> None:
+        """int8 wire-compressed allreduce: ``q8`` (C-contiguous int8,
+        scratch — destroyed) holds this rank's bucket quantized with
+        the symmetric per-bucket ``scale`` (true value = q * scale;
+        the caller computed scale = absmax/127 and keeps the
+        error-feedback residual); ``out`` (float32, same element
+        count) receives the dequantized sum, bitwise identical on
+        every rank. Wire pieces are [f32 running scale][int8 segment]
+        inside ordinary sealed payloads; requires FEAT_WIRE_Q8 on
+        every channel QP (fails fast otherwise)."""
+        ptr, _ = self._array_args(q8, "allreduce_q8")
+        optr, _ = self._array_args(out, "allreduce_q8 out")
+        if str(q8.dtype) != "int8" or str(out.dtype) != "float32":
+            raise TransportError(
+                "allreduce_q8 needs int8 q8 + float32 out")
+        if out.size != q8.size:
+            raise TransportError("allreduce_q8: q8/out size mismatch")
+        rc = _load().tdr_ring_allreduce_q8(
+            _live(self._h, "ring_allreduce_q8"), ptr, q8.size,
+            float(scale), optr)
+        _check(rc == 0, "ring_allreduce_q8")
+
+    def allreduce_q8_async(self, q8, scale: float, out) -> "RingOp":
+        """Nonblocking ``allreduce_q8`` on the same async driver (and
+        submission-order SPMD contract) as ``allreduce_async``. BOTH
+        buffers must stay alive and untouched until the handle
+        completes (the RingOp pins them)."""
+        ptr, _ = self._array_args(q8, "allreduce_q8_async")
+        optr, _ = self._array_args(out, "allreduce_q8_async out")
+        if str(q8.dtype) != "int8" or str(out.dtype) != "float32":
+            raise TransportError(
+                "allreduce_q8 needs int8 q8 + float32 out")
+        if out.size != q8.size:
+            raise TransportError("allreduce_q8: q8/out size mismatch")
+        h = _load().tdr_ring_start_q8(
+            _live(self._h, "ring_start_q8"), ptr, q8.size, float(scale),
+            optr)
+        _check(h, "ring_start_q8")
+        return RingOp(h, (q8, out))
 
     def reduce_scatter_async(self, array, op: int = RED_SUM) -> "RingOp":
         """Nonblocking reduce-scatter on the same async driver (and

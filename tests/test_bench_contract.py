@@ -149,7 +149,9 @@ def test_bench_record_carries_overlap_and_honest_gate(bench_run):
     assert ts["windows"] == sorted(ts["windows"])
     assert frac == ts["windows"][-1]  # best window, detail alongside
     assert ts["bucketed_step_s"] > 0 and ts["fused_step_s"] > 0
-    assert ts["wire_dtype"] == "bf16"
+    # r11 moved the smoke's train loop to per-layer taps + int8 wire.
+    assert ts["wire_dtype"] == "int8"
+    assert ts["per_layer"] is True
     gate = record["allreduce_world4_gate"]
     assert gate["metric"] in ("vs_bound", "vs_host_bound")
     assert (gate["metric"] == "vs_bound") == (gate["host_cores"] >= 2)
@@ -264,6 +266,71 @@ def test_bench_record_carries_serving_datapoint(bench_run):
     assert out["serve_prefetch_overlap_fraction"] == frac
 
 
+def test_bench_record_carries_compute_split_and_wire_compression(
+        bench_run):
+    """BENCH_r11 contract: the record carries the compute/staging
+    overlap SPLIT (wire events under the nested trainer.backward span
+    vs the post-backward gather loop — the >= 0.7 gate holds the
+    compute share, which staging-only overlap cannot satisfy), the
+    smoke's cores-aware compute gate and the bucketed-vs-fused
+    step-time gate (both in the BENCH_r08 gate-object shape), and the
+    wire-compression sweep — on-wire bytes per sync at f32/bf16/int8
+    on the same overlapped schedule, with the core-count-INDEPENDENT
+    int8 <= 0.55x bf16 bytes gate (byte accounting is deterministic,
+    so this gate must be met on any host, quick mode included)."""
+    out = json.loads(bench_run.stdout.splitlines()[-1])
+    details_path = out["details_file"]
+    if not os.path.isabs(details_path):
+        details_path = os.path.join(REPO, details_path)
+    record_path = os.path.join(os.path.dirname(details_path),
+                               out["bench_record"])
+    with open(record_path) as f:
+        record = json.load(f)
+    ts = record["train_step"]
+    cfrac = record["train_step_compute_overlap_fraction"]
+    sfrac = record["train_step_staging_overlap_fraction"]
+    assert cfrac == ts["compute_overlap_fraction"]
+    assert sfrac == ts["staging_overlap_fraction"]
+    assert 0.0 <= cfrac <= 1.0 and 0.0 <= sfrac <= 1.0
+    # The split is a partition of the coarse fraction (rounding slack).
+    assert abs(cfrac + sfrac - record["train_step_overlap_fraction"]) \
+        < 0.01, (cfrac, sfrac, record["train_step_overlap_fraction"])
+    assert ts["compute_windows"] == sorted(ts["compute_windows"])
+    cg = record["train_step_compute_gate"]
+    assert cg["metric"] == "train_step_compute_overlap_fraction"
+    assert cg["value"] == cfrac
+    assert isinstance(cg["met"], bool)
+    # r08 cores-aware convention: met, or a 1-core bound_note.
+    assert cg["met"] or (cg["bound_note"] and cg["host_cores"] < 2) \
+        or cg["host_cores"] >= 2, cg
+    tg = record["train_step_time_gate"]
+    assert tg["metric"] == "train_step_bucketed_vs_fused_s"
+    assert tg["threshold"] == 1.0
+    assert tg["value"] > 0
+    assert tg["met"] == (tg["value"] <= 1.0)
+    assert tg["met"] or (tg["bound_note"] and tg["host_cores"] < 2) \
+        or tg["host_cores"] >= 2, tg
+    wc = record["wire_compression"]
+    rows = wc["by_wire"]
+    assert set(rows) == {"f32", "bf16", "int8"}, rows
+    for row in rows.values():
+        assert row["wire_tx_bytes_per_sync"] > 0
+        assert row["step_s"] > 0
+    f32b = rows["f32"]["wire_tx_bytes_per_sync"]
+    b16b = rows["bf16"]["wire_tx_bytes_per_sync"]
+    i8b = rows["int8"]["wire_tx_bytes_per_sync"]
+    assert i8b < b16b < f32b, rows
+    bg = record["wire_bytes_gate"]
+    assert bg["metric"] == "wire_bytes_int8_vs_bf16"
+    assert bg["threshold"] == 0.55
+    assert abs(bg["value"] - i8b / b16b) < 0.01
+    # Byte accounting is deterministic — no cores-aware escape hatch.
+    assert bg["met"] is True, bg
+    # headline carries both r11 numbers (bounded-line contract holds).
+    assert out["train_step_compute_overlap_fraction"] == cfrac
+    assert out["wire_bytes_int8_vs_bf16"] == wc["int8_vs_bf16_bytes"]
+
+
 def test_committed_bench_record_meets_hier_acceptance():
     """The round's OFFICIAL record (BENCH_r09.json): world-8
     hierarchical beats the flat ring at the largest benched message
@@ -322,6 +389,29 @@ def test_committed_bench_record_meets_serving_acceptance():
     heal = record["serve_heal"]
     assert heal["failed"] >= 1 and heal["retransmitted"] >= 1, heal
     assert record["serve_scenario"]["bitwise_ok"] is True
+
+
+def test_committed_bench_record_meets_r11_acceptance():
+    """The round's OFFICIAL record (BENCH_r11.json, written by a real
+    full-size run on the bench host): the per-layer int8 train loop's
+    compute-overlap gate is met OR documents the cores-aware bound,
+    ditto the bucketed-vs-fused step-time gate, and the int8 wire
+    carries <= 0.55x the bf16 bytes — the byte gate has no cores
+    escape hatch (accounting is deterministic on any host)."""
+    with open(os.path.join(REPO, "BENCH_r11.json")) as f:
+        record = json.load(f)
+    assert record["round"] == "r11"
+    assert record["quick_mode"] is False
+    ts = record["train_step"]
+    assert ts["per_layer"] is True and ts["wire_dtype"] == "int8"
+    cg = record["train_step_compute_gate"]
+    assert cg["met"] or cg["bound_note"], cg
+    tg = record["train_step_time_gate"]
+    assert tg["met"] or tg["bound_note"], tg
+    bg = record["wire_bytes_gate"]
+    assert bg["met"] is True, bg
+    assert record["wire_compression"]["by_wire"]["int8"][
+        "wire_tx_bytes_per_sync"] > 0
 
 
 def test_channels_one_reproduces_legacy_single_qp_digest():

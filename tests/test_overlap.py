@@ -27,6 +27,28 @@ that safe:
   frame NAKs/retransmits and heals bitwise (compressed frames are
   ordinary sealed payloads);
 - the overlap trainer trains in lockstep with the fused trainer.
+
+The int8 wire (``TDR_WIRE_DTYPE=int8``: symmetric per-bucket absmax
+quantization at staging, scale exchanged alongside the payload, native
+running-scale dequant-fold) and the per-layer backward taps
+(``per_layer=True``: custom_vjp delivers each layer's grads DURING the
+jitted backward, so bucket k's allreduce launches while layer k-1
+computes) extend the same pins:
+
+- int8 results are bitwise IDENTICAL across ranks (the allgather
+  circulates [scale][payload] pieces verbatim) and within the
+  quantization bound of the fused f32 sync, including odd/remainder
+  bucket splits at world 2 and 4;
+- ``wire=int8`` is digest-carried, so ranks disagreeing on the wire
+  dtype fail the FIRST collective fast instead of mis-folding;
+- int8 error feedback provably bounds drift (20-step run vs a no-EF
+  control), and a corrupt rider on an int8 frame NAKs/retransmits and
+  heals bitwise;
+- with FEAT_WIRE_Q8 un-negotiated (``TDR_NO_WIRE_Q8=1``) the q8
+  schedule fails fast per-link while legacy traffic is untouched;
+- the per-layer trainer trains in lockstep with the fused trainer
+  (f32 bitwise-tolerance parity), and the recorder's
+  compute/staging overlap split attributes wire events correctly.
 """
 
 import threading
@@ -506,3 +528,354 @@ def test_trainer_overlap_trains_in_lockstep_with_fused():
                     jax.tree_util.tree_leaves(o_params[1])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-6)
+
+
+# ----------------------------------------------------- int8 wire (q8)
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_wire_int8_cross_rank_bitwise_and_near_fused(world):
+    """int8 wire results are bitwise IDENTICAL across ranks — the
+    allgather circulates each owner's [scale][int8] pieces VERBATIM
+    and every rank dequantizes the same bytes — and each leaf stays
+    within the per-bucket quantization bound of the fused f32 sync.
+    The odd bucket size exercises remainder segments at both worlds."""
+    fused = _sync_pair(world, {})
+    q8 = _sync_pair(world, {"overlap": True, "bucket_bytes": 130172,
+                            "wire_dtype": "int8"})
+    for r in range(1, world):
+        for a, b in zip(q8[0], q8[r]):
+            assert a.tobytes() == b.tobytes(), r
+    for f, q in zip(fused[0], q8[0]):
+        assert float(np.max(np.abs(q))) > 0.0, "q8 result collapsed"
+        # Each rank's symmetric quantization error is <= scale/2 with
+        # scale = absmax/127; summed over ranks plus fold rounding this
+        # is comfortably inside absmax*world/127 — tight enough to
+        # catch any routing/segment bug, loose enough for honest
+        # rounding.
+        atol = float(np.max(np.abs(f))) * world / 127.0 + 1e-6
+        np.testing.assert_allclose(q, f, rtol=0.0, atol=atol)
+
+
+def test_wire_int8_digest_term_and_divergence_fails_fast(monkeypatch):
+    """The wire dtype is schedule-changing, so it is digest-carried:
+    an int8 run's describe string grows ``wire=int8`` and its digest
+    differs from fused; and a fleet where rank 0 staged int8 while
+    rank 1 staged bf16 fails the FIRST collective on EVERY rank with
+    the SPMD-mismatch taxonomy — frames from one schedule are never
+    folded by the other."""
+    captured = {}
+    orig = RingWorld.check_schedule
+
+    def spy(self, digest, describe=""):
+        captured.setdefault(self._spy_tag, []).append((digest, describe))
+        return orig(self, digest, describe)
+
+    monkeypatch.setattr(RingWorld, "check_schedule", spy)
+
+    def run(tag, **kw):
+        worlds = local_worlds(2, free_port())
+        for w in worlds:
+            w._spy_tag = tag
+        try:
+            _run_shims(worlds, kw, [_exact_tree(r) for r in range(2)])
+        finally:
+            for w in worlds:
+                w.close()
+
+    run("fused")
+    run("q8", overlap=True, wire_dtype="int8")
+    assert "wire=int8" in captured["q8"][0][1]
+    assert captured["q8"][0][0] != captured["fused"][0][0]
+
+    monkeypatch.setattr(RingWorld, "check_schedule", orig)
+    worlds = local_worlds(2, free_port())
+    kws = [{"overlap": True, "wire_dtype": "int8"},
+           {"overlap": True, "wire_dtype": "bf16"}]
+    shims = [CrossSliceAllReduce(worlds[r], mean=True, **kws[r])
+             for r in range(2)]
+    errs = [None, None]
+    try:
+        def go(r):
+            try:
+                shims[r]([_exact_tree(r)[0]])
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                errs[r] = e
+
+        ts = [threading.Thread(target=go, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        for s in shims:
+            s.close()
+        for w in worlds:
+            w.close()
+    assert all(e is not None for e in errs), \
+        "wire-dtype divergence went unnoticed"
+    for e in errs:
+        assert isinstance(e, TransportError), e
+        assert "schedule mismatch" in str(e), e
+
+
+def test_wire_int8_tolerance_and_error_feedback_bounds_drift():
+    """20 synthetic training steps with int8 on-wire quantization.
+
+    Every regular gradient element is 0.25 while a planted 127.0
+    anchor in each bucket pins the symmetric scale at absmax/127 =
+    1.0, so the wire value rint(0.25) = 0 loses the ENTIRE gradient
+    each step: without error feedback the drift vs the uncompressed
+    run grows linearly (~steps*lr*0.25); WITH error feedback the
+    residual accumulates until it crosses half a quantization step and
+    the wire corrects — over any 4-step window the full 1.0 is
+    delivered, bounding the drift to ~a quantum."""
+    steps, lr, n = 20, 0.5, 2048
+    bucket = 4096  # 1024 f32 per bucket -> anchors at 0 and n//2
+
+    def train(wire, keep_ef):
+        worlds = local_worlds(2, free_port())
+        kw = ({"overlap": True, "bucket_bytes": bucket,
+               "wire_dtype": wire} if wire else {})
+        shims = [CrossSliceAllReduce(w, mean=True, **kw) for w in worlds]
+        params = [np.zeros(n, dtype=np.float32) for _ in range(2)]
+        try:
+            for _ in range(steps):
+                def step(r):
+                    g = np.full(n, 0.25, dtype=np.float32)
+                    g[0] = g[n // 2] = np.float32(127.0)
+                    (mean_g,) = shims[r]([g])
+                    params[r] -= lr * mean_g
+                ts = [threading.Thread(target=step, args=(r,))
+                      for r in range(2)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if not keep_ef:
+                    for s in shims:
+                        for res in s._residuals.values():
+                            res[:] = 0.0
+        finally:
+            for s in shims:
+                s.close()
+            for w in worlds:
+                w.close()
+        return params[0]
+
+    exact = train(None, True)
+    with_ef = train("int8", True)
+    without_ef = train("int8", False)
+    drift_ef = float(np.max(np.abs(with_ef - exact)))
+    drift_no = float(np.max(np.abs(without_ef - exact)))
+    # No-EF: all 20 steps' 0.25 contributions vanish -> 20*0.5*0.25.
+    assert drift_no > 2.0, drift_no
+    assert drift_ef < drift_no, (drift_ef, drift_no)
+    # EF: at most one in-flight quantum of residual times lr.
+    assert drift_ef < 1.0, drift_ef
+
+
+def test_corrupt_rider_on_int8_frame_naks_and_heals(monkeypatch):
+    """int8 [scale][payload] frames are ordinary sealed payloads: a
+    deterministic send-site corruption under full CMA sealing fails
+    verification, NAKs, retransmits clean, and the healed int8 result
+    is BITWISE the uncorrupted int8 run (symmetric quantization is
+    deterministic, so heal-exactness is checkable)."""
+    monkeypatch.setenv("TDR_SEAL_CMA", "1")
+    monkeypatch.setenv("TDR_RING_CHUNK", str(16 << 10))
+    kw = {"overlap": True, "bucket_bytes": 32 << 10,
+          "wire_dtype": "int8"}
+
+    def run():
+        worlds = local_worlds(2, free_port())
+        try:
+            trees = [[(np.arange(16384, dtype=np.float32) % 977)
+                      * np.float32(1.0009) * (r + 1)]
+                     for r in range(2)]
+            return _run_shims(worlds, kw, trees)
+        finally:
+            for w in worlds:
+                w.close()
+
+    clean = run()
+    monkeypatch.setenv("TDR_FAULT_PLAN", "send:chunk=0:nth=1:corrupt=3")
+    fault_plan_reset()
+    seal_counters_reset()
+    try:
+        healed = run()
+        c = seal_counters()
+        assert c["failed"] >= 1 and c["retransmitted"] >= 1, c
+        for r in range(2):
+            for a, b in zip(clean[r], healed[r]):
+                assert a.tobytes() == b.tobytes()
+    finally:
+        monkeypatch.delenv("TDR_FAULT_PLAN", raising=False)
+        fault_plan_reset()
+        seal_counters_reset()
+
+
+def test_wire_q8_feat_off_fails_fast_and_legacy_unchanged(monkeypatch):
+    """TDR_NO_WIRE_Q8 drops FEAT_WIRE_Q8 at the advertising stage:
+    no ring QP negotiates it, the q8 schedule fails FAST per-link (the
+    digest carries fleet-wide agreement; the handshake carries the
+    per-link capability), and legacy traffic on the same world is
+    byte-identical to a fully-featured world's — the feature bit is
+    the ONLY thing that moves."""
+    monkeypatch.setenv("TDR_NO_WIRE_Q8", "1")
+    worlds = local_worlds(2, free_port())
+    shims = [CrossSliceAllReduce(w, mean=True, overlap=True,
+                                 wire_dtype="int8") for w in worlds]
+    errs = [None, None]
+    try:
+        assert all(not w.wire_q8 for w in worlds)
+
+        def go(r):
+            try:
+                shims[r]([_exact_tree(r)[0]])
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                errs[r] = e
+
+        ts = [threading.Thread(target=go, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(e is not None for e in errs), \
+            "q8 ran without FEAT_WIRE_Q8"
+        for e in errs:
+            assert isinstance(e, TransportError), e
+            assert "FEAT_WIRE_Q8" in str(e), e
+
+        # Legacy traffic on the feature-less world: bitwise the
+        # expected mean — frames without the q8 bit are untouched.
+        legacy = _run_shims(worlds, {}, [_exact_tree(r)
+                                         for r in range(2)])
+    finally:
+        for s in shims:
+            s.close()
+        for w in worlds:
+            w.close()
+    monkeypatch.delenv("TDR_NO_WIRE_Q8")
+    featured = _sync_pair(2, {})
+    for a, b in zip(legacy[0], featured[0]):
+        assert a.tobytes() == b.tobytes()
+
+
+# ------------------------------------------- per-layer backward taps
+
+
+def test_trainer_per_layer_trains_in_lockstep_with_fused():
+    """The per-layer tap path (custom_vjp delivering each layer's
+    grads DURING the jitted backward, ordered io_callback) trains in
+    lockstep with the fused-sync pair: same loss trajectory, ranks in
+    lockstep, async handles demonstrably carried the buckets and all
+    settled. The int8 flavor of the same pair stays within the
+    error-feedback drift bound."""
+    from rocnrdma_tpu.parallel.trainer import Trainer
+    from rocnrdma_tpu.utils.trace import trace
+
+    rng = np.random.default_rng(4)
+    batches = [rng.integers(0, 255, (2, 17)).astype(np.int32)
+               for _ in range(2)]
+
+    def run_pair(**shim_kw):
+        worlds = local_worlds(2, free_port())
+        shims = [CrossSliceAllReduce(w, mean=True, **shim_kw)
+                 for w in worlds]
+        trainers = [Trainer("llama-tiny", {"dp": 1, "tp": 1}, seed=5,
+                            cross_slice_sync=shims[r])
+                    for r in range(2)]
+        if shim_kw.get("per_layer"):
+            assert all(t._per_layer for t in trainers)
+            assert all(t.layer_plan for t in trainers)
+        losses = [[], []]
+
+        def run_slice(r):
+            for step in range(2):
+                losses[r].append(trainers[r].step(batches[r]))
+
+        ts = [threading.Thread(target=run_slice, args=(r,))
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        params = [trainers[r].params for r in range(2)]
+        pend = [w.pending_async for w in worlds]
+        for s in shims:
+            s.close()
+        for w in worlds:
+            w.close()
+        assert pend == [0, 0], "leaked async handles"
+        return losses, params
+
+    before = trace.counter("world.allreduce_async")
+    p_losses, p_params = run_pair(per_layer=True,
+                                  bucket_bytes=64 << 10)
+    assert trace.counter("world.allreduce_async") > before, \
+        "per-layer path never launched an async collective"
+    f_losses, f_params = run_pair()
+    for a, b in zip(p_losses[0] + p_losses[1],
+                    f_losses[0] + f_losses[1]):
+        assert abs(a - b) < 5e-4, (p_losses, f_losses)
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(p_params[0]),
+                    jax.tree_util.tree_leaves(p_params[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+    q_losses, _ = run_pair(per_layer=True, wire_dtype="int8",
+                           bucket_bytes=64 << 10)
+    for a, b in zip(q_losses[0] + q_losses[1],
+                    f_losses[0] + f_losses[1]):
+        assert abs(a - b) < 5e-2, (q_losses, f_losses)
+
+
+def test_overlap_fraction_compute_staging_split():
+    """The recorder's split attribution on a synthetic timeline: wire
+    events under the nested ``trainer.backward`` span count as COMPUTE
+    overlap, events under ``trainer.grads`` but past the backward span
+    count as STAGING overlap, events outside both count as serial —
+    and ``overlap_fraction`` stays their sum, so pre-split consumers
+    read the same number. A nonzero drop count taints all three."""
+    from rocnrdma_tpu.telemetry.recorder import TelEvent, overlap_fraction
+
+    t0 = 1_000_000_000
+    ms = 1_000_000
+
+    def span(name, start_ms, dur_ms):
+        return TelEvent(ts_ns=t0 + (start_ms + dur_ms) * ms, name=name,
+                        source="python",
+                        fields={"dur_s": dur_ms / 1000.0})
+
+    def wire(at_ms):
+        return TelEvent(ts_ns=t0 + at_ms * ms, name="wire_tx",
+                        source="native")
+
+    events = [span("trainer.grads", 0, 100),
+              span("trainer.backward", 0, 60),
+              wire(10), wire(30), wire(50),    # under the backward jit
+              wire(70), wire(90),              # grads span, post-compute
+              wire(150), wire(170)]            # fully serial
+    out = overlap_fraction(events, dropped=0)
+    assert out["wire_events"] == 7
+    assert out["wire_in_span"] == 5
+    assert out["wire_in_compute"] == 3
+    assert out["overlap_fraction"] == round(5 / 7, 4)
+    assert out["compute_overlap_fraction"] == round(3 / 7, 4)
+    assert out["staging_overlap_fraction"] == round(2 / 7, 4)
+    assert out["overlap_fraction"] == round(
+        out["compute_overlap_fraction"]
+        + out["staging_overlap_fraction"], 4)
+    assert out["spans"] == 1 and out["compute_spans"] == 1
+    assert not out["tainted"]
+
+    tainted = overlap_fraction(events, dropped=3)
+    assert tainted["tainted"] and tainted["dropped"] == 3
+    # Compute events can never exceed span events, even on a
+    # pathological timeline where the nesting is violated.
+    weird = [span("trainer.backward", 0, 60), wire(10), wire(30)]
+    w = overlap_fraction(weird, dropped=0)
+    assert w["wire_in_compute"] <= w["wire_in_span"]
+    assert w["staging_overlap_fraction"] >= 0.0

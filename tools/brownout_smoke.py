@@ -7,9 +7,16 @@ browned out with netem riders — per-frame delay plus a throttle pacer
 — and the run gates that the fleet degrades instead of dying:
 
 - **Zero rebuilds**: the link-health EWMA collapses against its own
-  baseline, the ladder falls hier→flat (and arms the bf16 wire rung on
-  the way down), and NOT ONE collective escalates to the
-  deadline/probe/rebuild machinery. ``world.rebuild`` must not move.
+  baseline, the ladder falls hier→flat (arming the bf16 wire rung and
+  then the int8 rung on the way down), and NOT ONE collective
+  escalates to the deadline/probe/rebuild machinery.
+  ``world.rebuild`` must not move.
+- **The full three-rung walk, in order**: the thresholds are spaced so
+  the EWMA decay crosses them on different samples — the per-iteration
+  rung census must show a bf16-only state BEFORE the first int8 state
+  BEFORE the first fallback state, and both wire counters
+  (``health.wire_bf16`` / ``health.wire_int8``) must move: collectives
+  actually ran on each rung, not just engaged it.
 - **One measured hier→flat fallback**: ``algo.degraded`` must move —
   a soak where the ladder never engaged proves nothing.
 - **Healed parity**: after the riders clear, probation canaries
@@ -17,8 +24,12 @@ browned out with netem riders — per-frame delay plus a throttle pacer
   sick link) raise the score past the heal hysteresis, the rungs
   disengage, and the schedule returns to hier — with every phase's
   results bitwise-equal to the numpy oracle throughout (brownout,
-  fallback, bf16 rung, and healed alike: integer-valued floats are
-  exact under the mantissa truncation, by construction).
+  fallback, bf16 rung, int8 rung, and healed alike — see the data
+  construction below: the delegate shards are integers with absmax
+  exactly 127 and equal across hosts, so the bf16 truncation is
+  lossless (<= 8 significant bits) AND the int8 quantization is exact
+  (scale == 1.0) AND the native running-scale fold divides evenly
+  (rint((v+v)/2) == v), by construction).
 - **Flat thread census**: after close, no ``tdr-`` thread survives —
   a brownout must not leak progress shards or heartbeats.
 
@@ -56,11 +67,18 @@ os.environ.setdefault("TDR_RING_CHANNELS", "1")
 os.environ["TDR_TOPOLOGY"] = "a,a,b,b"
 os.environ.setdefault("TDR_HEALTH_MIN_BYTES", "262144")
 os.environ.setdefault("TDR_HEALTH_PROBE_EVERY", "2")
-os.environ.setdefault("TDR_HEALTH_WIRE", "0.6")
-os.environ.setdefault("TDR_HEALTH_FALLBACK", "0.4")
+# Three rungs, spaced so the EWMA decay (score ~ 0.7^n under the
+# brownout, alpha=0.3) crosses them on DIFFERENT samples with the
+# 2-sample streak: bf16 engages around sample 2-3, int8 around 4-5,
+# fallback around 5-7 — the walk is observable per iteration, not a
+# single cliff where every rung arms at once.
+os.environ.setdefault("TDR_HEALTH_WIRE", "0.72")
+os.environ.setdefault("TDR_HEALTH_WIRE_INT8", "0.45")
+os.environ.setdefault("TDR_HEALTH_FALLBACK", "0.3")
 os.environ.setdefault("TDR_HEALTH_ENGAGE_STREAK", "2")
 os.environ.setdefault("TDR_COLL_DEADLINE_MS", "60000")
 os.environ.pop("TDR_NO_DEGRADE", None)
+os.environ.pop("TDR_NO_WIRE_Q8", None)  # the int8 rung must be armable
 
 # NOT imported from hier_smoke: importing it would run its module
 # prelude (an 8-rank TDR_TOPOLOGY and corrupt riders) over this
@@ -142,19 +160,40 @@ def main() -> int:
     rebuilds0 = trace.counter("world.rebuild")
     degraded0 = trace.counter("algo.degraded")
     hier0 = trace.counter("algo.hier")
+    bf16_0 = trace.counter("health.wire_bf16")
+    int8_0 = trace.counter("health.wire_int8")
 
+    # Data construction for bitwise parity on EVERY rung: after the
+    # intra reduce-scatter, each host's delegate holds the intra-host
+    # sum v over its owned half-slice — the tensor every wire rung
+    # quantizes. Choose per-rank data x and v-x (host a), y and v-y
+    # (host b) so BOTH hosts' delegate shards equal the same integer
+    # vector v in [-127, 127] with absmax EXACTLY 127 planted in each
+    # half-slice. Then the bf16 truncation is lossless (|v| <= 127
+    # needs <= 7 significant bits), the int8 quantization is exact
+    # (scale = absmax/127 = 1.0, q = v), and the native running-scale
+    # fold divides evenly (s_n = 2, q_n = rint((v + v)/2) = v, dequant
+    # 2v = the true 4-rank sum). One oracle covers every phase.
     rng = np.random.default_rng(23)
-    data = rng.integers(-100, 100, (world, count)).astype(np.float32)
-    expect = data.sum(axis=0)
+    half = count // 2
+    v = rng.integers(-126, 127, count).astype(np.float32)
+    v[0], v[half] = 127.0, -127.0  # absmax == 127 in BOTH shard halves
+    x = rng.integers(-100, 101, count).astype(np.float32)
+    y = rng.integers(-100, 101, count).astype(np.float32)
+    data = np.stack([x, v - x, y, v - y])
+    expect = data.sum(axis=0)  # == 2v, exact in f32
 
     worlds = local_worlds(world, port_band(world * 4 + 8))
     wname = worlds[0].world_name
     ok = True
+    # Per-iteration rung census (bf16, int8, fallback) — the walk
+    # assertion scans the brownout segment of this list.
+    ladder = []
 
     def sweep(iters, phase):
         """``iters`` hier-candidate allreduces, every result checked
-        bitwise against the numpy oracle (exact-in-f32 sums survive
-        the bf16 rung losslessly, so ONE predicate covers every rung
+        bitwise against the numpy oracle (the data construction above
+        makes every rung lossless, so ONE predicate covers every rung
         the ladder may be on)."""
         for i in range(iters):
             bufs = [data[r].copy() for r in range(world)]
@@ -164,6 +203,9 @@ def main() -> int:
                 if bufs[r].tobytes() != expect.tobytes():
                     raise AssertionError(
                         f"parity broke: phase={phase} iter={i} rank={r}")
+            ladder.append((health.wire_downgrade(wname),
+                           health.wire_int8(wname),
+                           health.fallback_active(wname)))
 
     try:
         # ---- phase 1: clean baseline (peaks establish "healthy") ----
@@ -176,8 +218,9 @@ def main() -> int:
         # ---- phase 2: brownout the delegate link ----
         os.environ["TDR_FAULT_PLAN"] = BROWNOUT_PLAN
         fault_plan_reset()
+        walk_from = len(ladder)
         t0 = time.perf_counter()
-        sweep(8, "brownout")
+        sweep(10, "brownout")
         out["brownout_s"] = round(time.perf_counter() - t0, 3)
         out["fault_hits"] = sum(fault_plan_hits(i)
                                 for i in range(fault_plan_clauses()))
@@ -189,6 +232,35 @@ def main() -> int:
         ok &= out["fallback_engaged"]        # the ladder engaged
         ok &= out["degraded_switches"] > 0   # ...and rerouted traffic
 
+        # ---- the three-rung walk, in order (the r11 satellite) ----
+        # The census must show bf16-only BEFORE the first int8 state
+        # BEFORE the first fallback state, and collectives must have
+        # RUN on both wire rungs (the counters move only when a hier
+        # collective crosses the delegate link on that rung).
+        seg = ladder[walk_from:]
+        out["ladder_walk"] = ["".join(("b" if b else "-",
+                                       "i" if i8 else "-",
+                                       "f" if fb else "-"))
+                              for b, i8, fb in seg]
+
+        def first(pred):
+            return next((i for i, st in enumerate(seg) if pred(st)),
+                        None)
+
+        i_bf16 = first(lambda st: st[0] and not st[1] and not st[2])
+        i_int8 = first(lambda st: st[1] and not st[2])
+        i_flat = first(lambda st: st[2])
+        out["walk_ordered"] = (i_bf16 is not None and i_int8 is not None
+                               and i_flat is not None
+                               and i_bf16 < i_int8 < i_flat)
+        out["wire_bf16_collectives"] = (trace.counter("health.wire_bf16")
+                                        - bf16_0)
+        out["wire_int8_collectives"] = (trace.counter("health.wire_int8")
+                                        - int8_0)
+        ok &= out["walk_ordered"]
+        ok &= out["wire_bf16_collectives"] > 0
+        ok &= out["wire_int8_collectives"] > 0
+
         # ---- phase 3: clear the riders, heal through canaries ----
         os.environ.pop("TDR_FAULT_PLAN", None)
         fault_plan_reset()
@@ -196,11 +268,13 @@ def main() -> int:
         for _ in range(40):
             sweep(1, "heal")
             if not health.fallback_active(wname) and \
-                    not health.wire_downgrade(wname):
+                    not health.wire_downgrade(wname) and \
+                    not health.wire_int8(wname):
                 break
         out["heal_s"] = round(time.perf_counter() - t0, 3)
         out["healed"] = (not health.fallback_active(wname)
-                         and not health.wire_downgrade(wname))
+                         and not health.wire_downgrade(wname)
+                         and not health.wire_int8(wname))
         sweep(2, "healed")  # healed parity, back on the hier schedule
         ok &= out["healed"]
 

@@ -2,21 +2,34 @@
 """overlap_smoke — the backward-overlap trainer path, end to end.
 
 CI hook for `make overlap-smoke` / `overlap-smoke-san`: a world-2
-bucketed train loop over the async collective handles, flight recorder
-on, asserting:
+PER-LAYER train loop (gradient taps deliver each layer's grads during
+the backward pass; bucket k's allreduce launches while XLA is still
+computing layer k-1's grads) over int8 wire compression, flight
+recorder on, asserting:
 
-  - measured ``overlap_fraction`` (wire events inside the
-    ``trainer.grads`` span / total wire events — the share of wire
-    traffic hidden behind the backward pass) exceeds 0.3;
-  - the bucketed trainer's losses match the fused-sync pair (the
-    overlap is an execution strategy, never a numerics change);
+  - measured ``compute_overlap_fraction`` (wire events inside the
+    nested ``trainer.backward`` span / total wire events — the share
+    of wire traffic hidden behind the backward COMPUTATION, not just
+    the post-backward staging loop) exceeds the cores-aware gate
+    (0.7 on >= 2-core hosts; on one core the bound note records why
+    the bar cannot be measured — the BENCH_r08 convention);
+  - the coarser ``overlap_fraction`` (wire inside ``trainer.grads``)
+    still exceeds TDR_OVERLAP_GATE (0.3) — staging overlap alone can
+    no longer satisfy the headline gate, but it must not regress;
+  - the per-layer trainer's losses match the fused-sync pair within
+    the int8+error-feedback training tolerance (the overlap is an
+    execution strategy; the quantization error is bounded by EF);
   - handle-leak-free shutdown: every world's ``pending_async`` census
     returns to zero and the native thread census (the
     test_multichannel settle-loop) is flat across the loop + close —
     no leaked async-driver or shard thread survives.
 
 Full mode drives the real Trainer (llama-tiny, JAX CPU) through
-``CrossSliceAllReduce(overlap=True)``. The sanitized run
+``CrossSliceAllReduce(per_layer=True, wire_dtype="int8")``: gradient
+taps (identity custom_vjp + ordered io_callback) push each layer's
+grads to the shim DURING the jitted backward, where they quantize to
+int8 (per-bucket symmetric absmax scale, error-feedback residual) and
+launch on the async wire. The sanitized run
 (`overlap-smoke-san`, TDR_OVERLAP_SMOKE_LITE=1) is TRAINER-FREE —
 jaxlib's MLIR pybind throws C++ exceptions that trip ASan's
 __cxa_throw interceptor (the control-smoke-san rationale) — and drives
@@ -109,7 +122,13 @@ def lite_main() -> dict:
 
             def grads_and_launch(r):
                 try:
-                    with trace.span("trainer.grads", step=step):
+                    with trace.span("trainer.grads", step=step), \
+                            trace.span("trainer.backward", step=step):
+                        # The nested backward span mirrors the
+                        # trainer's shape: in lite mode the synthetic
+                        # "compute" (the copyto) and the launches both
+                        # live inside it, so the compute-overlap split
+                        # is measurable under ASan too.
                         for k in range(nbuckets):
                             # Synthetic backward: produce bucket k's
                             # bytes, then launch it while "computing"
@@ -155,14 +174,16 @@ def lite_main() -> dict:
 
 
 def full_main() -> dict:
-    """The real bucketed train loop: two 'slices' (llama-tiny, 6
-    layers — enough leaves that the gather side has realistic per-leaf
-    cost) averaging gradients through
-    ``CrossSliceAllReduce(overlap=True, wire_dtype="bf16")``, vs a
-    fused pair on the same batches for loss parity and the step-time
-    comparison.
+    """The real per-layer train loop: two 'slices' (llama-tiny, 6
+    layers — enough param subtrees that the tap schedule has realistic
+    per-layer granularity) averaging gradients through
+    ``CrossSliceAllReduce(per_layer=True, wire_dtype="int8")`` — each
+    layer's grads delivered mid-backward by the trainer's gradient
+    taps, quantized to int8 and launched on the async wire while XLA
+    computes the next layer — vs a fused f32 pair on the same batches
+    for loss parity and the step-time comparison.
 
-    The overlap fraction is measured over WINDOWS of steps and
+    The overlap fractions are measured over WINDOWS of steps and
     reported as best-of-N with every window alongside (the repo's
     best-measured convention, cf. the channel sweep): on a 1-core
     host, scheduler noise swamps a single-window estimate — one
@@ -178,12 +199,12 @@ def full_main() -> dict:
     bucket_bytes = 32 << 10
     windows = 2 if QUICK else 3
 
-    def make_pair(overlap, wire):
+    def make_pair(per_layer, wire):
         worlds = local_worlds(2, free_port())
         shims = [CrossSliceAllReduce(
-            w, mean=True, overlap=overlap,
-            bucket_bytes=bucket_bytes if overlap else None,
-            wire_dtype=wire)
+            w, mean=True, per_layer=per_layer, wire_dtype=wire)
+            if per_layer else
+            CrossSliceAllReduce(w, mean=True, wire_dtype=wire)
             for w in worlds]
         trainers = [Trainer("llama-tiny", {"dp": 1, "tp": 1}, seed=3,
                             cross_slice_sync=shims[r], n_layers=6)
@@ -207,7 +228,7 @@ def full_main() -> dict:
         return (time.perf_counter() - t0) / n
 
     telemetry.enable()
-    worlds, shims, trainers = make_pair(True, "bf16")
+    worlds, shims, trainers = make_pair(True, "int8")
     o_losses = [[], []]
     steps(trainers, 1, o_losses)  # warmup: compiles, sizes staging
     # Census baseline AFTER the warmup step: jax's process-wide pools,
@@ -224,7 +245,7 @@ def full_main() -> dict:
     steady = settle_census(baseline)
     assert steady <= baseline, \
         (f"native threads grew {baseline} -> {steady} across "
-         f"{windows * STEPS} bucketed steps: per-step thread leak")
+         f"{windows * STEPS} per-layer steps: per-step thread leak")
     pend = [w.pending_async for w in worlds]
     for s in shims:
         s.close()
@@ -240,9 +261,9 @@ def full_main() -> dict:
         (f"native threads {baseline} -> {closed} after closing the "
          "overlap pair: driver/engine threads leaked past close")
 
-    # Fused pair on the same batches: loss parity (overlap +
-    # compression-with-error-feedback stays within training tolerance)
-    # and the step-time comparison; census flat across it too.
+    # Fused f32 pair on the same batches: loss parity (per-layer
+    # overlap + int8-with-error-feedback stays within training
+    # tolerance) and the step-time comparison; census flat too.
     worlds, shims, trainers = make_pair(False, None)
     f_losses = [[], []]
     steps(trainers, 1, f_losses)
@@ -260,15 +281,24 @@ def full_main() -> dict:
             assert abs(a - b) < 5e-3, (r, o_losses[r], f_losses[r])
     telemetry.disable()
     by_frac = sorted(f["overlap_fraction"] for f in fracs)
-    best = max(fracs, key=lambda f: f["overlap_fraction"])
+    by_cfrac = sorted(f["compute_overlap_fraction"] for f in fracs)
+    best = max(fracs, key=lambda f: (f["compute_overlap_fraction"],
+                                     f["overlap_fraction"]))
     return {"mode": "full", "steps": STEPS, "windows": by_frac,
-            "bucket_bytes": bucket_bytes, "wire_dtype": "bf16",
+            "compute_windows": by_cfrac,
+            "bucket_bytes": bucket_bytes, "wire_dtype": "int8",
+            "per_layer": True,
             "bucketed_step_s": sorted(walls)[len(walls) // 2],
             "fused_step_s": fused_s,
             "overlap_fraction": best["overlap_fraction"],
             "overlap_fraction_median": by_frac[len(by_frac) // 2],
+            "compute_overlap_fraction":
+                best["compute_overlap_fraction"],
+            "staging_overlap_fraction":
+                best["staging_overlap_fraction"],
             "span": best["span"], "wire_events": best["wire_events"],
-            "wire_in_span": best["wire_in_span"]}
+            "wire_in_span": best["wire_in_span"],
+            "wire_in_compute": best["wire_in_compute"]}
 
 
 def main() -> int:
@@ -277,15 +307,54 @@ def main() -> int:
     # run (overlap-smoke-san) sets it low — ASan multiplies the
     # native wire's cost while numpy compute runs unsanitized, so the
     # timing claim is not meaningful there; that run's job is the
-    # memory-error/UB sweep of the handle machinery.
+    # memory-error/UB sweep of the handle machinery. The COMPUTE gate
+    # (wire under trainer.backward — the split staging overlap cannot
+    # satisfy) defaults to 0.7 in full mode and follows the coarse
+    # gate in lite mode (ASan rationale above); it is cores-aware per
+    # the BENCH_r08 convention — on a 1-core host the jitted backward
+    # and the wire progress threads timeshare the core, so the share
+    # of frames the scheduler lands under the compute span is
+    # scheduler-bound, not machinery-bound.
     gate = float(os.environ.get("TDR_OVERLAP_GATE", "0.3"))
+    cgate = float(os.environ.get("TDR_OVERLAP_COMPUTE_GATE",
+                                 str(gate) if LITE else "0.7"))
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    cfrac = out["compute_overlap_fraction"]
+    met = cfrac > cgate
+    bound_note = None
+    if not met and cores < 2:
+        bound_note = (
+            "1-core host: the jitted backward and the wire progress "
+            "threads timeshare the single core, so the share of wire "
+            "events the scheduler lands inside trainer.backward is "
+            "scheduler-bound, not machinery-bound — gate measured "
+            "only with >= 2 usable cores (BENCH_r08 cores-aware "
+            "convention; re-scored automatically when CI regains "
+            "cores)")
+    out["compute_gate"] = {
+        "metric": "train_step_compute_overlap_fraction",
+        "threshold": cgate,
+        "host_cores": cores,
+        "value": cfrac,
+        "met": met,
+        "bound_note": bound_note,
+    }
     print("OVERLAP " + json.dumps(out))
     assert out["wire_events"] > 0, "no wire events recorded"
     assert out["overlap_fraction"] > gate, \
         (f"overlap_fraction {out['overlap_fraction']} <= {gate}: the "
          "wire is not hiding behind the backward pass")
+    assert met or bound_note is not None, \
+        (f"compute_overlap_fraction {cfrac} <= {cgate} on a "
+         f"{cores}-core host: the wire is not hiding behind the "
+         "backward COMPUTATION (staging overlap alone cannot satisfy "
+         "this gate)")
     print(f"overlap-smoke OK: mode={out['mode']} "
           f"overlap_fraction={out['overlap_fraction']} "
+          f"compute_overlap_fraction={cfrac} "
           f"wire_events={out['wire_events']}")
     return 0
 

@@ -307,6 +307,45 @@ def main() -> int:
     noprefetch_tokens_s = max(np_windows)
     telemetry.disable()
 
+    # 5. Jitted paged decode (ROADMAP item 2 residual (b)): the same
+    # loopback workload through ``jit_decode=True`` — donated-cache
+    # jitted layer steps — vs the numpy port, both measured WARM (a
+    # throwaway batch compiles both shapes first; compile time is a
+    # one-off, not a decode rate). Greedy tokens must match the numpy
+    # port exactly; the decode-rate delta is recorded, not gated (on
+    # tiny configs the per-slot dispatch overhead can eat the matmul
+    # win — the number is the honest datapoint either way). LITE mode
+    # records null: this is the one serving leg that imports jax.
+    jit_decode = None
+    if not LITE:
+        def decode_rate(jit):
+            b = ContinuousBatcher(None, pages, cfg, max_slots=4,
+                                  prefetch=False, jit_decode=jit)
+            b.submit(Request(30, [2, 5, 3], 4))   # warmup: compiles
+            b.run()
+            for i in range(4):
+                b.submit(Request(40 + i, [2 + i, 5, 3], gen))
+            t0 = time.perf_counter()
+            b.run()
+            dt = time.perf_counter() - t0
+            toks = {rid: r.tokens
+                    for rid, r in sorted(b.finished.items())
+                    if rid >= 40}
+            b.close()
+            n = sum(len(t) for t in toks.values())
+            return round(n / dt, 3), toks
+
+        np_rate, np_toks = decode_rate(False)
+        jit_rate, jit_toks = decode_rate(True)
+        assert jit_toks == np_toks, \
+            (f"jit paged decode diverged from the numpy port:\n"
+             f"  jit={jit_toks}\n  numpy={np_toks}")
+        jit_decode = {"tokens_s_numpy": np_rate,
+                      "tokens_s_jit": jit_rate,
+                      "speedup": round(jit_rate / np_rate, 3)
+                      if np_rate else None,
+                      "tokens_match": True}
+
     prefetch_tokens_s = max(pre_windows)
     try:
         cores = len(os.sched_getaffinity(0))
@@ -338,6 +377,7 @@ def main() -> int:
         "noprefetch_tokens_s": noprefetch_tokens_s,
         "tokens_s_windows": {"prefetch": sorted(pre_windows),
                              "noprefetch": sorted(np_windows)},
+        "jit_decode": jit_decode,
         "heal": {"failed": heal.get("failed", 0),
                  "retransmitted": heal.get("retransmitted", 0)},
         "scenario": {"evicted": 1, "joined_midstream": 1,
